@@ -1,0 +1,27 @@
+"""heat_tpu — a TPU-native distributed tensor framework.
+
+Capabilities of the reference Heat framework (distributed NumPy/SciPy/
+scikit-learn-style computing; /root/reference/heat/__init__.py), re-designed
+single-controller on JAX/XLA: the array is a ``jax.Array`` with a GSPMD
+``NamedSharding`` derived from its ``split`` axis, communication lowers to
+XLA collectives over the ICI/DCN mesh, and one process drives the device
+population.
+
+Usage mirrors the reference::
+
+    import heat_tpu as ht
+    x = ht.arange(10, split=0)
+    print(ht.sum(x))
+"""
+
+import jax as _jax
+
+# float64/int64 parity with the reference's NumPy semantics; defaults in
+# factories remain float32/int32, so TPU hot paths stay in fast dtypes.
+_jax.config.update("jax_enable_x64", True)
+
+from .core import *
+from .core.linalg import *
+
+from . import core
+from .version import __version__

@@ -1,0 +1,28 @@
+"""heat_tpu core: distributed array, type system, operator surface.
+
+Mirrors the reference layout /root/reference/heat/core/__init__.py — the
+flat ``ht.*`` namespace re-exports every surface module.
+"""
+
+from .communication import *
+from .devices import *
+from .types import *
+from .dndarray import *
+from .factories import *
+from .arithmetics import *
+from .complex_math import *
+from .exponential import *
+from .logical import *
+from .manipulations import *
+from .memory import *
+from .printing import *
+from .relational import *
+from .rounding import *
+from .sanitation import *
+from .stride_tricks import *
+from .trigonometrics import *
+
+from . import linalg
+from .linalg import *
+
+from ..version import __version__

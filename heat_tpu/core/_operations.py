@@ -1,0 +1,265 @@
+"""Generic distributed operation wrappers.
+
+API parity with /root/reference/heat/core/_operations.py: ``__binary_op``
+(_operations.py:22), ``__cum_op`` (:204), ``__local_op`` (:305),
+``__reduce_op`` (:378). The reference versions interleave type promotion
+with explicit redistribution (`sanitize_distribution`) and MPI collectives
+(`Allreduce` when the reduction axis includes the split,
+_operations.py:466-471; `Exscan` for cumulative ops). Here the local torch
+kernel becomes a jnp/XLA op on the global sharded array: GSPMD inserts the
+equivalent collectives (a reduction over the sharded axis lowers to the
+same all-reduce over ICI), so these wrappers shrink to type promotion,
+split bookkeeping and sharding constraints.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from typing import Callable, Optional, Union
+
+from . import types
+from .communication import sanitize_comm
+from .devices import sanitize_device
+from .dndarray import DNDarray
+from .stride_tricks import broadcast_shape, sanitize_axis
+
+__all__ = []
+
+
+def _as_dndarray(x, reference: DNDarray) -> DNDarray:
+    """Promote scalars / array-likes to DNDarray on the reference's comm."""
+    from . import factories
+
+    if isinstance(x, DNDarray):
+        return x
+    return factories.array(
+        x, device=reference.device, comm=reference.comm, split=None
+    )
+
+
+def __binary_op(
+    operation: Callable,
+    t1: Union[DNDarray, int, float],
+    t2: Union[DNDarray, int, float],
+    out: Optional[DNDarray] = None,
+    where: Optional[DNDarray] = None,
+    fn_kwargs: Optional[dict] = None,
+) -> DNDarray:
+    """Generic elementwise binary operation (reference: _operations.py:22).
+
+    Promotes types on the torch/XLA lattice, broadcasts, resolves the
+    output split by the dominant-operand rule (reference
+    _operations.py:147-168) and applies ``operation`` to the global arrays;
+    distribution matching is a resharding constraint instead of explicit
+    redistribution.
+    """
+    fn_kwargs = fn_kwargs or {}
+
+    if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
+        raise TypeError(f"at least one operand must be a DNDarray, got {type(t1)}, {type(t2)}")
+
+    ref = t1 if isinstance(t1, DNDarray) else t2
+
+    # scalar fast-path: keep weak typing so int + float32-array stays float32
+    scalar1 = not isinstance(t1, DNDarray)
+    scalar2 = not isinstance(t2, DNDarray)
+
+    promoted = types.result_type(t1, t2)
+    jt = promoted.jax_type()
+
+    a1 = t1 if scalar1 else t1.larray
+    a2 = t2 if scalar2 else t2.larray
+    if scalar1 and not isinstance(t1, (int, float, complex, bool)):
+        a1 = jnp.asarray(np.asarray(t1))
+        scalar1 = False
+    if scalar2 and not isinstance(t2, (int, float, complex, bool)):
+        a2 = jnp.asarray(np.asarray(t2))
+        scalar2 = False
+
+    if not scalar1:
+        a1 = a1.astype(jt)
+    if not scalar2:
+        a2 = a2.astype(jt)
+
+    shape1 = () if scalar1 else tuple(t1.shape) if isinstance(t1, DNDarray) else tuple(a1.shape)
+    shape2 = () if scalar2 else tuple(t2.shape) if isinstance(t2, DNDarray) else tuple(a2.shape)
+    output_shape = broadcast_shape(shape1, shape2)
+    out_ndim = len(output_shape)
+
+    # dominant split resolution in output coordinates
+    def _out_split(t, shape):
+        if not isinstance(t, DNDarray) or t.split is None:
+            return None
+        return t.split + (out_ndim - t.ndim)
+
+    s1 = _out_split(t1, shape1)
+    s2 = _out_split(t2, shape2)
+    if s1 is not None and s2 is not None and s1 != s2:
+        # align t2 to t1's split (reference redistributes the non-dominant operand)
+        t2 = t2.resplit(s1 - (out_ndim - t2.ndim)) if 0 <= s1 - (out_ndim - t2.ndim) else t2
+        a2 = t2.larray.astype(jt)
+        s2 = _out_split(t2, shape2)
+    output_split = s1 if s1 is not None else s2
+    # a broadcast dimension of extent 1 cannot carry the split
+    if output_split is not None and output_shape[output_split] == 1:
+        output_split = None
+
+    result = operation(a1, a2, **fn_kwargs)
+
+    if where is not None:
+        w = where.larray if isinstance(where, DNDarray) else jnp.asarray(where)
+        base = out.larray.astype(result.dtype) if out is not None else jnp.zeros_like(result)
+        result = jnp.where(w, result, base)
+
+    comm = ref.comm
+    device = ref.device
+    if output_split is not None:
+        result = comm.shard(result, output_split)
+
+    res_type = types.canonical_heat_type(result.dtype)
+    if out is not None:
+        from .sanitation import sanitize_out
+
+        from . import _padding
+
+        sanitize_out(out, output_shape, output_split, device)
+        buffered = _padding.unpad(result, output_shape, output_split).astype(out.dtype.jax_type())
+        out.larray = buffered
+        return out
+
+    return DNDarray(result, output_shape, res_type, output_split, device, comm)
+
+
+def __cum_op(
+    operation: Callable,
+    x: DNDarray,
+    axis: int,
+    out: Optional[DNDarray] = None,
+    dtype=None,
+) -> DNDarray:
+    """Generic cumulative op (reference: _operations.py:204 — local cumop +
+    ``Exscan`` + combine). A jnp cumulative op on the sharded array lowers
+    to the same scan-with-carry across shards.
+    """
+    from .sanitation import sanitize_in
+
+    sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+    if axis is None:
+        raise NotImplementedError("cumulative operation over flattened array: ravel first")
+
+    arr = x.larray
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+        arr = arr.astype(dtype.jax_type())
+    result = operation(arr, axis=axis)
+    res_type = types.canonical_heat_type(result.dtype)
+    comm = x.comm
+    if x.split is not None:
+        result = comm.shard(result, x.split)
+
+    if out is not None:
+        from .sanitation import sanitize_out
+
+        from . import _padding
+
+        sanitize_out(out, x.shape, x.split, x.device)
+        out.larray = _padding.unpad(result, x.shape, x.split).astype(out.dtype.jax_type())
+        return out
+    return DNDarray(result, x.shape, res_type, x.split, x.device, comm)
+
+
+def __local_op(
+    operation: Callable,
+    x: DNDarray,
+    out: Optional[DNDarray] = None,
+    no_cast: bool = False,
+    **kwargs,
+) -> DNDarray:
+    """Generic pure-local elementwise op (reference: _operations.py:305) —
+    no communication; sharding is preserved by XLA elementwise semantics.
+    """
+    from .sanitation import sanitize_in
+
+    sanitize_in(x)
+    arr = x.larray
+    if not no_cast and types.heat_type_is_exact(x.dtype):
+        promoted = types.promote_types(x.dtype, types.float32)
+        arr = arr.astype(promoted.jax_type())
+
+    result = operation(arr, **kwargs)
+    res_type = types.canonical_heat_type(result.dtype)
+    split = x.split if result.ndim == x.ndim else None
+    output_shape = tuple(int(s) for s in result.shape)
+    if split is not None:
+        result = x.comm.shard(result, split)
+
+    if out is not None:
+        from .sanitation import sanitize_out
+        from . import _padding
+
+        sanitize_out(out, output_shape, split, x.device)
+        out.larray = _padding.unpad(result, output_shape, split).astype(out.dtype.jax_type())
+        return out
+    return DNDarray(result, output_shape, res_type, split, x.device, x.comm)
+
+
+def __reduce_op(
+    partial_op: Callable,
+    x: DNDarray,
+    axis: Optional[Union[int, tuple]] = None,
+    neutral=None,
+    out: Optional[DNDarray] = None,
+    keepdims: bool = False,
+    **kwargs,
+) -> DNDarray:
+    """Generic reduction (reference: _operations.py:378 — local partial
+    reduce followed by ``Allreduce`` when ``split in axis``,
+    _operations.py:466-471). The jnp reduction over the sharded global
+    array makes XLA emit that same all-reduce over the mesh.
+    """
+    from .sanitation import sanitize_in
+
+    sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+
+    kwargs.pop("out", None)
+    result = partial_op(x.larray, axis=axis, keepdims=keepdims, **kwargs)
+    if not isinstance(result, jax.Array):
+        result = jnp.asarray(result)
+
+    # output split bookkeeping
+    split = x.split
+    if split is None:
+        output_split = None
+    elif axis is None:
+        output_split = None
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        if split in axes:
+            output_split = None
+        elif keepdims:
+            output_split = split
+        else:
+            output_split = split - sum(1 for a in axes if a < split)
+
+    comm = x.comm
+    output_shape = tuple(int(s) for s in result.shape)
+    if output_split is not None:
+        result = comm.shard(result, output_split)
+
+    res_type = types.canonical_heat_type(result.dtype)
+
+    if out is not None:
+        from .sanitation import sanitize_out
+
+        from . import _padding
+
+        sanitize_out(out, output_shape, output_split, x.device)
+        out.larray = _padding.unpad(result, output_shape, output_split).astype(out.dtype.jax_type())
+        return out
+    return DNDarray(result, output_shape, res_type, output_split, x.device, comm)

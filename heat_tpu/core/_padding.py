@@ -1,0 +1,94 @@
+"""Pad-and-mask machinery for uneven shards.
+
+GSPMD requires the sharded dimension extent to be divisible by the mesh
+axis size; the reference instead gives the first ``size % w`` MPI ranks one
+extra element (communication.py:156). The TPU-native resolution is the
+standard pad-and-mask idiom: the *physical* array carries a zero-filled
+tail along ``split`` rounded up to a mesh multiple, while all metadata
+(``gshape``) stays logical. Invariant maintained throughout the framework:
+**the pad region of every DNDarray's physical array is zero.** Sum-like
+contractions (matmul, sum) are then pad-safe for free; other reductions
+refill the pad with their neutral element first; exports slice the pad off.
+
+Divisible shapes take none of these paths — zero overhead on the shapes
+benchmarks use.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from typing import Optional, Tuple
+
+__all__ = [
+    "phys_shape",
+    "pad_extent",
+    "pad_logical",
+    "unpad",
+    "mask_phys",
+    "valid_mask",
+]
+
+
+def pad_extent(n: int, size: int) -> int:
+    """Physical extent: n rounded up to a multiple of ``size``."""
+    if size <= 1 or n == 0:
+        return n
+    return -(-n // size) * size
+
+
+def phys_shape(gshape: Tuple[int, ...], split: Optional[int], size: int) -> Tuple[int, ...]:
+    """Physical (padded) shape for a logical global shape."""
+    if split is None or not gshape:
+        return tuple(gshape)
+    out = list(gshape)
+    out[split] = pad_extent(out[split], size)
+    return tuple(out)
+
+
+def pad_logical(arr: jax.Array, split: Optional[int], size: int, fill=0) -> jax.Array:
+    """Zero-pad a logical array along ``split`` up to the physical extent."""
+    if split is None:
+        return arr
+    n = arr.shape[split]
+    target = pad_extent(n, size)
+    if target == n:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[split] = (0, target - n)
+    return jnp.pad(arr, widths, constant_values=fill)
+
+
+def unpad(arr: jax.Array, gshape: Tuple[int, ...], split: Optional[int]) -> jax.Array:
+    """Slice the logical region out of a physical array."""
+    if split is None:
+        return arr
+    n = gshape[split]
+    if arr.shape[split] == n:
+        return arr
+    sl = [slice(None)] * arr.ndim
+    sl[split] = slice(0, n)
+    return arr[tuple(sl)]
+
+
+def valid_mask(phys: jax.Array, gshape: Tuple[int, ...], split: Optional[int]) -> Optional[jax.Array]:
+    """Boolean mask of the logical region, or None when nothing is padded."""
+    if split is None:
+        return None
+    n = gshape[split]
+    if phys.shape[split] == n:
+        return None
+    iota = jax.lax.broadcasted_iota(jnp.int32, phys.shape, split)
+    return iota < n
+
+
+def mask_phys(phys: jax.Array, gshape: Tuple[int, ...], split: Optional[int], fill=0) -> jax.Array:
+    """Overwrite the pad region with ``fill`` (restores the zero-pad
+    invariant, or installs a reduction-neutral element)."""
+    mask = valid_mask(phys, gshape, split)
+    if mask is None:
+        return phys
+    return jnp.where(mask, phys, jnp.asarray(fill, dtype=phys.dtype))

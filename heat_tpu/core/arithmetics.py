@@ -1,0 +1,339 @@
+"""Arithmetic operations.
+
+API parity with /root/reference/heat/core/arithmetics.py (39 exports, all
+built on the generic wrappers of ``_operations``). Each op is a jnp/XLA
+kernel on the sharded global array; reductions over the split axis lower to
+all-reduce over the mesh (reference: ``__reduce_op`` path,
+_operations.py:466-471), ``diff`` needs the same neighbor exchange the
+reference performs explicitly (arithmetics.py `diff`) — emitted by XLA from
+the shifted-slice formulation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from typing import Optional, Union
+
+from . import types
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = [
+    "add",
+    "bitwise_and",
+    "bitwise_not",
+    "bitwise_or",
+    "bitwise_xor",
+    "copysign",
+    "cumprod",
+    "cumproduct",
+    "cumsum",
+    "diff",
+    "div",
+    "divmod",
+    "divide",
+    "floordiv",
+    "floor_divide",
+    "fmod",
+    "gcd",
+    "hypot",
+    "invert",
+    "lcm",
+    "left_shift",
+    "mod",
+    "mul",
+    "multiply",
+    "nan_to_num",
+    "nanprod",
+    "nansum",
+    "neg",
+    "negative",
+    "pos",
+    "positive",
+    "pow",
+    "power",
+    "prod",
+    "remainder",
+    "right_shift",
+    "sub",
+    "subtract",
+    "sum",
+]
+
+
+def add(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise addition (reference: arithmetics.py add)."""
+    return _operations.__binary_op(jnp.add, t1, t2, out, where)
+
+
+def _check_int_or_bool(t, name):
+    for t_ in (t,):
+        if isinstance(t_, DNDarray) and types.heat_type_is_inexact(t_.dtype):
+            raise TypeError(f"operation {name} not supported for float dtype {t_.dtype}")
+
+
+def bitwise_and(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise AND of integer/boolean arrays."""
+    _check_int_or_bool(t1, "bitwise_and"), _check_int_or_bool(t2, "bitwise_and")
+    return _operations.__binary_op(jnp.bitwise_and, t1, t2, out, where)
+
+
+def bitwise_or(t1, t2, out=None, where=None) -> DNDarray:
+    _check_int_or_bool(t1, "bitwise_or"), _check_int_or_bool(t2, "bitwise_or")
+    return _operations.__binary_op(jnp.bitwise_or, t1, t2, out, where)
+
+
+def bitwise_xor(t1, t2, out=None, where=None) -> DNDarray:
+    _check_int_or_bool(t1, "bitwise_xor"), _check_int_or_bool(t2, "bitwise_xor")
+    return _operations.__binary_op(jnp.bitwise_xor, t1, t2, out, where)
+
+
+def bitwise_not(t, out=None) -> DNDarray:
+    """Elementwise NOT; alias ``invert``."""
+    _check_int_or_bool(t, "bitwise_not")
+    return _operations.__local_op(jnp.bitwise_not, t, out, no_cast=True)
+
+
+invert = bitwise_not
+
+
+def copysign(t1, t2, out=None, where=None) -> DNDarray:
+    """Magnitude of t1 with sign of t2."""
+    return _operations.__binary_op(jnp.copysign, t1, t2, out, where)
+
+
+def cumprod(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative product along ``axis`` (reference: __cum_op with Multiply
+    + Exscan; here a sharded jnp.cumprod)."""
+    return _operations.__cum_op(jnp.cumprod, a, axis, out=out, dtype=dtype)
+
+
+cumproduct = cumprod
+
+
+def cumsum(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative sum along ``axis``."""
+    return _operations.__cum_op(jnp.cumsum, a, axis, out=out, dtype=dtype)
+
+
+def diff(a: DNDarray, n: int = 1, axis: int = -1) -> DNDarray:
+    """n-th discrete difference along ``axis`` (reference arithmetics.py
+    diff performs explicit split-axis neighbor comm; the shifted-slice
+    difference makes XLA emit the same halo exchange)."""
+    from .stride_tricks import sanitize_axis
+
+    if n == 0:
+        return a
+    if n < 0:
+        raise ValueError(f"order must be non-negative but was {n}")
+    axis = sanitize_axis(a.shape, axis)
+    result = jnp.diff(a.larray, n=n, axis=axis)
+    split = a.split
+    if split is not None:
+        result = a.comm.shard(result, split)
+    return DNDarray(
+        result,
+        tuple(int(s) for s in result.shape),
+        types.canonical_heat_type(result.dtype),
+        split,
+        a.device,
+        a.comm,
+    )
+
+
+def div(t1, t2, out=None, where=None) -> DNDarray:
+    """True division (reference: arithmetics.py div)."""
+    return _operations.__binary_op(jnp.true_divide, t1, t2, out, where)
+
+
+divide = div
+
+
+def divmod(t1, t2, out1=None, out2=None, out=None, where=None):
+    """Elementwise (floordiv, mod) pair."""
+    if out is None:
+        out = (out1, out2)
+    if not isinstance(out, tuple) or len(out) != 2:
+        raise ValueError("out must be a tuple of two DNDarrays")
+    d = floordiv(t1, t2, out[0], where)
+    m = mod(t1, t2, out[1], where)
+    return d, m
+
+
+def floordiv(t1, t2, out=None, where=None) -> DNDarray:
+    """Floor division."""
+    return _operations.__binary_op(jnp.floor_divide, t1, t2, out, where)
+
+
+floor_divide = floordiv
+
+
+def fmod(t1, t2, out=None, where=None) -> DNDarray:
+    """C-style remainder (sign of dividend)."""
+    return _operations.__binary_op(jnp.fmod, t1, t2, out, where)
+
+
+def gcd(t1, t2, out=None, where=None) -> DNDarray:
+    """Greatest common divisor of integer arrays."""
+    return _operations.__binary_op(jnp.gcd, t1, t2, out, where)
+
+
+def hypot(t1, t2, out=None, where=None) -> DNDarray:
+    """Hypotenuse sqrt(t1**2 + t2**2)."""
+    return _operations.__binary_op(jnp.hypot, t1, t2, out, where)
+
+
+def lcm(t1, t2, out=None, where=None) -> DNDarray:
+    """Least common multiple of integer arrays."""
+    return _operations.__binary_op(jnp.lcm, t1, t2, out, where)
+
+
+def left_shift(t1, t2, out=None, where=None) -> DNDarray:
+    """Bitwise left shift."""
+    _check_int_or_bool(t1, "left_shift")
+    return _operations.__binary_op(jnp.left_shift, t1, t2, out, where)
+
+
+def mod(t1, t2, out=None, where=None) -> DNDarray:
+    """Python-style modulo (sign of divisor); alias ``remainder``."""
+    return _operations.__binary_op(jnp.mod, t1, t2, out, where)
+
+
+remainder = mod
+
+
+def mul(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise multiplication."""
+    return _operations.__binary_op(jnp.multiply, t1, t2, out, where)
+
+
+multiply = mul
+
+
+def nan_to_num(a: DNDarray, nan=0.0, posinf=None, neginf=None, out=None) -> DNDarray:
+    """Replace NaN/inf with finite numbers."""
+    return _operations.__local_op(
+        jnp.nan_to_num, a, out, no_cast=True, nan=nan, posinf=posinf, neginf=neginf
+    )
+
+
+def nanprod(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Product ignoring NaNs (reference: arithmetics.py nanprod)."""
+    return _operations.__reduce_op(jnp.nanprod, a, axis=axis, out=out, keepdims=keepdims)
+
+
+def nansum(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Sum ignoring NaNs."""
+    return _operations.__reduce_op(jnp.nansum, a, axis=axis, out=out, keepdims=keepdims)
+
+
+def neg(a: DNDarray, out=None) -> DNDarray:
+    """Elementwise negation."""
+    return _operations.__local_op(jnp.negative, a, out, no_cast=True)
+
+
+negative = neg
+
+
+def pos(a: DNDarray, out=None) -> DNDarray:
+    """Elementwise unary plus."""
+    return _operations.__local_op(jnp.positive, a, out, no_cast=True)
+
+
+positive = pos
+
+
+def pow(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise power."""
+    # fast-path: small integral scalar exponents keep dtype (numpy semantics)
+    if isinstance(t2, (int, float)) and float(t2).is_integer():
+        t2 = int(t2)
+    return _operations.__binary_op(jnp.power, t1, t2, out, where)
+
+
+power = pow
+
+
+def prod(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Product over ``axis`` (reference: __reduce_op with MPI.PROD)."""
+    return _operations.__reduce_op(jnp.prod, a, axis=axis, out=out, keepdims=keepdims)
+
+
+def right_shift(t1, t2, out=None, where=None) -> DNDarray:
+    """Bitwise right shift."""
+    _check_int_or_bool(t1, "right_shift")
+    return _operations.__binary_op(jnp.right_shift, t1, t2, out, where)
+
+
+def sub(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise subtraction."""
+    return _operations.__binary_op(jnp.subtract, t1, t2, out, where)
+
+
+subtract = sub
+
+
+def sum(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Sum over ``axis`` (reference: __reduce_op + Allreduce when the split
+    axis is reduced, _operations.py:466-471 — XLA emits that all-reduce)."""
+    return _operations.__reduce_op(jnp.sum, a, axis=axis, out=out, keepdims=keepdims)
+
+
+# ------------------------------------------------------------------ #
+# DNDarray operator / method attachment (reference attaches these     #
+# throughout arithmetics.py)                                          #
+# ------------------------------------------------------------------ #
+DNDarray.__add__ = lambda self, other: add(self, other)
+DNDarray.__radd__ = lambda self, other: add(other, self)
+DNDarray.__iadd__ = lambda self, other: add(self, other)
+DNDarray.__sub__ = lambda self, other: sub(self, other)
+DNDarray.__rsub__ = lambda self, other: sub(other, self)
+DNDarray.__isub__ = lambda self, other: sub(self, other)
+DNDarray.__mul__ = lambda self, other: mul(self, other)
+DNDarray.__rmul__ = lambda self, other: mul(other, self)
+DNDarray.__imul__ = lambda self, other: mul(self, other)
+DNDarray.__truediv__ = lambda self, other: div(self, other)
+DNDarray.__rtruediv__ = lambda self, other: div(other, self)
+DNDarray.__itruediv__ = lambda self, other: div(self, other)
+DNDarray.__floordiv__ = lambda self, other: floordiv(self, other)
+DNDarray.__rfloordiv__ = lambda self, other: floordiv(other, self)
+DNDarray.__mod__ = lambda self, other: mod(self, other)
+DNDarray.__rmod__ = lambda self, other: mod(other, self)
+DNDarray.__pow__ = lambda self, other: pow(self, other)
+DNDarray.__rpow__ = lambda self, other: pow(other, self)
+DNDarray.__neg__ = lambda self: neg(self)
+DNDarray.__pos__ = lambda self: pos(self)
+def _dunder_abs(self):
+    from . import rounding
+
+    return rounding.abs(self)
+
+
+DNDarray.__abs__ = _dunder_abs
+DNDarray.__invert__ = lambda self: invert(self)
+DNDarray.__and__ = lambda self, other: bitwise_and(self, other)
+DNDarray.__rand__ = lambda self, other: bitwise_and(other, self)
+DNDarray.__or__ = lambda self, other: bitwise_or(self, other)
+DNDarray.__ror__ = lambda self, other: bitwise_or(other, self)
+DNDarray.__xor__ = lambda self, other: bitwise_xor(self, other)
+DNDarray.__rxor__ = lambda self, other: bitwise_xor(other, self)
+DNDarray.__lshift__ = lambda self, other: left_shift(self, other)
+DNDarray.__rshift__ = lambda self, other: right_shift(self, other)
+DNDarray.__divmod__ = lambda self, other: divmod(self, other)
+
+DNDarray.add = add
+DNDarray.sub = sub
+DNDarray.mul = mul
+DNDarray.div = div
+DNDarray.pow = pow
+DNDarray.mod = mod
+DNDarray.sum = sum
+DNDarray.prod = prod
+DNDarray.nansum = nansum
+DNDarray.nanprod = nanprod
+DNDarray.cumsum = cumsum
+DNDarray.cumprod = cumprod

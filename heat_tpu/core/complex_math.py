@@ -1,0 +1,51 @@
+"""Complex number operations.
+
+API parity with /root/reference/heat/core/complex_math.py (5 exports).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from . import types
+from .dndarray import DNDarray
+
+__all__ = ["angle", "conj", "conjugate", "imag", "real"]
+
+
+def angle(x: DNDarray, deg: bool = False, out=None) -> DNDarray:
+    """Argument of the complex values (reference: complex_math.py angle)."""
+    result = _operations.__local_op(jnp.angle, x, out, no_cast=True)
+    if deg:
+        from . import trigonometrics
+
+        result = trigonometrics.rad2deg(result, out=out)
+    return result
+
+
+def conj(x: DNDarray, out=None) -> DNDarray:
+    """Complex conjugate."""
+    return _operations.__local_op(jnp.conj, x, out, no_cast=True)
+
+
+conjugate = conj
+
+
+def imag(x: DNDarray) -> DNDarray:
+    """Imaginary part; zeros for real input (reference: complex_math.py imag)."""
+    if types.heat_type_is_complexfloating(x.dtype):
+        return _operations.__local_op(jnp.imag, x, None, no_cast=True)
+    from . import factories
+
+    return factories.zeros_like(x)
+
+
+def real(x: DNDarray) -> DNDarray:
+    """Real part; the array itself for real input."""
+    if types.heat_type_is_complexfloating(x.dtype):
+        return _operations.__local_op(jnp.real, x, None, no_cast=True)
+    return x
+
+
+DNDarray.conj = conj

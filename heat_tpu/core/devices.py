@@ -1,0 +1,161 @@
+"""Device abstraction for heat_tpu.
+
+API parity with the reference device module
+(/root/reference/heat/core/devices.py: ``Device`` at devices.py:17, ``cpu``
+singleton at :97, ``get_device``/``sanitize_device``/``use_device`` at
+:137-190), redesigned for JAX: a ``Device`` names a *platform* whose devices
+form the mesh, not a single rank-local accelerator. GPU round-robin
+assignment by MPI rank (reference devices.py:114-120) has no analog — the
+single controller owns every device of the platform.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from typing import Any, Optional, Union
+
+__all__ = ["Device", "cpu", "get_device", "sanitize_device", "use_device"]
+
+
+class Device:
+    """A platform on which heat_tpu arrays live.
+
+    Parameters
+    ----------
+    device_type : str
+        Platform name: ``'cpu'``, ``'gpu'`` or ``'tpu'``.
+    device_id : int
+        Principal device index (kept for reference-API parity; the mesh
+        spans all devices of the platform).
+    jax_platform : str
+        The JAX platform string backing this device.
+    """
+
+    def __init__(self, device_type: str, device_id: int = 0, jax_platform: Optional[str] = None):
+        self.__device_type = str(device_type)
+        self.__device_id = int(device_id)
+        self.__jax_platform = jax_platform if jax_platform is not None else str(device_type)
+
+    @property
+    def device_type(self) -> str:
+        return self.__device_type
+
+    @property
+    def device_id(self) -> int:
+        return self.__device_id
+
+    @property
+    def jax_platform(self) -> str:
+        return self.__jax_platform
+
+    # reference-API name (devices.py:76 exposes torch_device)
+    @property
+    def torch_device(self) -> str:
+        return f"{self.__jax_platform}:{self.__device_id}"
+
+    def jax_devices(self):
+        """All JAX devices of this platform (the mesh population)."""
+        return jax.devices(self.__jax_platform)
+
+    def __repr__(self) -> str:
+        return f"device({str(self)!r})"
+
+    def __str__(self) -> str:
+        return f"{self.__device_type}:{self.__device_id}"
+
+    def __eq__(self, other: Any) -> bool:
+        if isinstance(other, Device):
+            return self.device_type == other.device_type and self.device_id == other.device_id
+        if isinstance(other, str):
+            try:
+                other = sanitize_device(other)
+                return self == other
+            except (ValueError, TypeError):
+                return False
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(str(self))
+
+
+cpu = Device("cpu", 0, "cpu")
+"""The standard CPU device spanning all host devices."""
+
+# populate accelerator devices if the platforms exist
+_registry = {"cpu": cpu}
+
+
+def _detect_accelerators() -> None:
+    for platform in ("tpu", "gpu"):
+        try:
+            devs = jax.devices(platform)
+        except RuntimeError:
+            continue
+        if devs:
+            _registry[platform] = Device(platform, 0, platform)
+
+
+_detect_accelerators()
+
+# axon exposes TPUs under a plugin platform name; register under 'tpu' alias
+if "tpu" not in _registry:
+    try:
+        _default = jax.devices()
+        if _default and _default[0].platform not in ("cpu", "gpu"):
+            _registry["tpu"] = Device("tpu", 0, _default[0].platform)
+    except RuntimeError:
+        pass
+
+if "tpu" in _registry:
+    tpu = _registry["tpu"]
+    __all__.append("tpu")
+if "gpu" in _registry:
+    gpu = _registry["gpu"]
+    __all__.append("gpu")
+
+# default device follows the default JAX backend (TPU when present)
+try:
+    _backend = jax.default_backend()
+except RuntimeError:
+    _backend = "cpu"
+if _backend == "cpu":
+    __default_device = cpu
+elif _backend == "gpu":
+    __default_device = _registry.get("gpu", cpu)
+else:
+    __default_device = _registry.get("tpu", _registry.get(_backend, cpu))
+
+
+def get_device() -> Device:
+    """The currently globally set default device (reference: devices.py:137)."""
+    return __default_device
+
+
+def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
+    """Sanitize a device or device identifier (reference: devices.py:149)."""
+    if device is None:
+        return get_device()
+    if isinstance(device, Device):
+        return device
+    if isinstance(device, str):
+        name = device.strip().lower()
+        if ":" in name:
+            name, _, idx = name.partition(":")
+            try:
+                int(idx)
+            except ValueError:
+                raise ValueError(f"unknown device {device}")
+        if name in _registry:
+            return _registry[name]
+        if name in ("cuda",):
+            if "gpu" in _registry:
+                return _registry["gpu"]
+        raise ValueError(f"unknown device {device}")
+    raise ValueError(f"unknown device {device}")
+
+
+def use_device(device: Optional[Union[str, Device]] = None) -> None:
+    """Set the globally used default device (reference: devices.py:171)."""
+    global __default_device
+    __default_device = sanitize_device(device)

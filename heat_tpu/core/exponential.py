@@ -1,0 +1,92 @@
+"""Exponential and logarithmic functions.
+
+API parity with /root/reference/heat/core/exponential.py (11 exports, all
+pure-local elementwise).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = [
+    "exp",
+    "expm1",
+    "exp2",
+    "log",
+    "log2",
+    "log10",
+    "log1p",
+    "logaddexp",
+    "logaddexp2",
+    "sqrt",
+    "square",
+]
+
+
+def exp(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise e**x."""
+    return _operations.__local_op(jnp.exp, x, out)
+
+
+def expm1(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise e**x - 1 (accurate near zero)."""
+    return _operations.__local_op(jnp.expm1, x, out)
+
+
+def exp2(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise 2**x."""
+    return _operations.__local_op(jnp.exp2, x, out)
+
+
+def log(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise natural logarithm."""
+    return _operations.__local_op(jnp.log, x, out)
+
+
+def log2(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise base-2 logarithm."""
+    return _operations.__local_op(jnp.log2, x, out)
+
+
+def log10(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise base-10 logarithm."""
+    return _operations.__local_op(jnp.log10, x, out)
+
+
+def log1p(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise log(1+x) (accurate near zero)."""
+    return _operations.__local_op(jnp.log1p, x, out)
+
+
+def logaddexp(t1, t2) -> DNDarray:
+    """log(exp(t1) + exp(t2)) without overflow."""
+    return _operations.__binary_op(jnp.logaddexp, t1, t2)
+
+
+def logaddexp2(t1, t2) -> DNDarray:
+    """log2(2**t1 + 2**t2) without overflow."""
+    return _operations.__binary_op(jnp.logaddexp2, t1, t2)
+
+
+def sqrt(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise square root."""
+    return _operations.__local_op(jnp.sqrt, x, out)
+
+
+def square(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise square."""
+    return _operations.__local_op(jnp.square, x, out, no_cast=True)
+
+
+DNDarray.exp = exp
+DNDarray.log = log
+DNDarray.sqrt = sqrt
+DNDarray.square = square
+DNDarray.exp2 = exp2
+DNDarray.expm1 = expm1
+DNDarray.log2 = log2
+DNDarray.log10 = log10
+DNDarray.log1p = log1p

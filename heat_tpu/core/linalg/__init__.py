@@ -1,0 +1,3 @@
+"""Distributed linear algebra (reference: /root/reference/heat/core/linalg/)."""
+
+from .basics import *

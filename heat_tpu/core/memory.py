@@ -1,0 +1,33 @@
+"""Memory layout utilities.
+
+API parity with /root/reference/heat/core/memory.py (``copy`` at
+memory.py:13, ``sanitize_memory_layout`` at :42). XLA owns physical
+layout on TPU, so C/F-order stride permutation is metadata-only here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dndarray import DNDarray
+
+__all__ = ["copy", "sanitize_memory_layout"]
+
+
+def copy(a: DNDarray) -> DNDarray:
+    """Deep copy of ``a`` (reference: memory.py:13)."""
+    from .sanitation import sanitize_in
+
+    sanitize_in(a)
+    return DNDarray(
+        jnp.array(a.larray), a.gshape, a.dtype, a.split, a.device, a.comm, balanced=True
+    )
+
+
+def sanitize_memory_layout(x, order: str = "C"):
+    """Return data in the requested memory layout (reference: memory.py:42).
+    XLA chooses physical tiling on TPU — this validates and returns as-is.
+    """
+    if order not in ("C", "F", "K"):
+        raise ValueError(f"expected order to be 'C', 'F' or 'K', got {order}")
+    return x

@@ -1,0 +1,99 @@
+"""Elementwise comparison operations.
+
+API parity with /root/reference/heat/core/relational.py (12 exports, all
+via ``_operations.__binary_op``); results are boolean DNDarrays sharded
+like the dominant operand.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = [
+    "eq",
+    "equal",
+    "ge",
+    "greater",
+    "greater_equal",
+    "gt",
+    "le",
+    "less",
+    "less_equal",
+    "lt",
+    "ne",
+    "not_equal",
+]
+
+
+def eq(t1, t2) -> DNDarray:
+    """Elementwise ``t1 == t2`` (reference: relational.py eq)."""
+    return _operations.__binary_op(jnp.equal, t1, t2)
+
+
+def equal(t1, t2) -> bool:
+    """True if both arrays have the same shape and equal elements
+    (reference: relational.py equal returns a scalar verdict)."""
+    from .dndarray import DNDarray as _D
+
+    if not isinstance(t1, _D) and not isinstance(t2, _D):
+        raise TypeError("at least one operand must be a DNDarray")
+    s1 = tuple(t1.shape) if isinstance(t1, _D) else ()
+    s2 = tuple(t2.shape) if isinstance(t2, _D) else ()
+    if isinstance(t1, _D) and isinstance(t2, _D) and s1 != s2:
+        try:
+            _ = jnp.broadcast_shapes(s1, s2)
+        except ValueError:
+            return False
+    result = _operations.__binary_op(jnp.equal, t1, t2)
+    return bool(jnp.all(result.larray))
+
+
+def ge(t1, t2) -> DNDarray:
+    """Elementwise ``t1 >= t2``."""
+    return _operations.__binary_op(jnp.greater_equal, t1, t2)
+
+
+greater_equal = ge
+
+
+def gt(t1, t2) -> DNDarray:
+    """Elementwise ``t1 > t2``."""
+    return _operations.__binary_op(jnp.greater, t1, t2)
+
+
+greater = gt
+
+
+def le(t1, t2) -> DNDarray:
+    """Elementwise ``t1 <= t2``."""
+    return _operations.__binary_op(jnp.less_equal, t1, t2)
+
+
+less_equal = le
+
+
+def lt(t1, t2) -> DNDarray:
+    """Elementwise ``t1 < t2``."""
+    return _operations.__binary_op(jnp.less, t1, t2)
+
+
+less = lt
+
+
+def ne(t1, t2) -> DNDarray:
+    """Elementwise ``t1 != t2``."""
+    return _operations.__binary_op(jnp.not_equal, t1, t2)
+
+
+not_equal = ne
+
+DNDarray.__eq__ = lambda self, other: eq(self, other)
+DNDarray.__ne__ = lambda self, other: ne(self, other)
+DNDarray.__lt__ = lambda self, other: lt(self, other)
+DNDarray.__le__ = lambda self, other: le(self, other)
+DNDarray.__gt__ = lambda self, other: gt(self, other)
+DNDarray.__ge__ = lambda self, other: ge(self, other)
+DNDarray.__hash__ = None

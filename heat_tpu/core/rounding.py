@@ -1,0 +1,124 @@
+"""Rounding and sign operations.
+
+API parity with /root/reference/heat/core/rounding.py (11 exports).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from typing import Optional, Union
+
+from . import _operations
+from . import types
+from .dndarray import DNDarray
+
+__all__ = [
+    "abs",
+    "absolute",
+    "ceil",
+    "clip",
+    "fabs",
+    "floor",
+    "modf",
+    "round",
+    "sgn",
+    "sign",
+    "trunc",
+]
+
+
+def abs(x, out=None, dtype=None) -> DNDarray:
+    """Elementwise absolute value (reference: rounding.py abs)."""
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+    result = _operations.__local_op(jnp.abs, x, out, no_cast=True)
+    if dtype is not None and result.dtype != dtype:
+        result = result.astype(dtype, copy=out is None)
+    return result
+
+
+absolute = abs
+
+
+def ceil(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise ceiling."""
+    return _operations.__local_op(jnp.ceil, x, out)
+
+
+def clip(x: DNDarray, min=None, max=None, out=None) -> DNDarray:
+    """Clip values to [min, max] (reference: rounding.py clip requires at
+    least one bound)."""
+    if min is None and max is None:
+        raise ValueError("clip requires at least one of min or max")
+    if isinstance(min, DNDarray):
+        min = min.larray
+    if isinstance(max, DNDarray):
+        max = max.larray
+    return _operations.__local_op(jnp.clip, x, out, no_cast=True, min=min, max=max)
+
+
+def fabs(x: DNDarray, out=None) -> DNDarray:
+    """Float absolute value (casts exact types to float)."""
+    return _operations.__local_op(jnp.abs, x, out, no_cast=False)
+
+
+def floor(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise floor."""
+    return _operations.__local_op(jnp.floor, x, out)
+
+
+def modf(x: DNDarray, out=None):
+    """Fractional and integral parts (reference: rounding.py modf)."""
+    from .sanitation import sanitize_in
+
+    sanitize_in(x)
+    frac, integ = jnp.modf(x.larray.astype(types.promote_types(x.dtype, types.float32).jax_type()))
+    comm, device, split = x.comm, x.device, x.split
+    res_t = types.canonical_heat_type(frac.dtype)
+    f = DNDarray(comm.shard(frac, split) if split is not None else frac, x.shape, res_t, split, device, comm)
+    i = DNDarray(comm.shard(integ, split) if split is not None else integ, x.shape, res_t, split, device, comm)
+    if out is not None:
+        if not isinstance(out, tuple) or len(out) != 2:
+            raise TypeError("out must be a 2-tuple of DNDarrays")
+        out[0].larray = f.larray
+        out[1].larray = i.larray
+        return out
+    return f, i
+
+
+def round(x: DNDarray, decimals: int = 0, out=None, dtype=None) -> DNDarray:
+    """Round to ``decimals`` (reference: rounding.py round)."""
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+    result = _operations.__local_op(jnp.round, x, out, decimals=decimals)
+    if dtype is not None and result.dtype != dtype:
+        result = result.astype(dtype, copy=out is None)
+    return result
+
+
+def sgn(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise sign (complex: x/|x|)."""
+    return _operations.__local_op(jnp.sign, x, out, no_cast=True)
+
+
+def sign(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise sign; for complex input the sign of the real part
+    (reference: rounding.py sign follows numpy)."""
+    if types.heat_type_is_complexfloating(x.dtype):
+        return _operations.__local_op(lambda a: jnp.sign(jnp.real(a)).astype(a.dtype), x, out, no_cast=True)
+    return _operations.__local_op(jnp.sign, x, out, no_cast=True)
+
+
+def trunc(x: DNDarray, out=None) -> DNDarray:
+    """Truncate toward zero."""
+    return _operations.__local_op(jnp.trunc, x, out)
+
+
+DNDarray.abs = abs
+DNDarray.ceil = ceil
+DNDarray.clip = clip
+DNDarray.fabs = fabs
+DNDarray.floor = floor
+DNDarray.round = round
+DNDarray.trunc = trunc

@@ -1,0 +1,133 @@
+"""Input validation and distribution matching.
+
+API parity with /root/reference/heat/core/sanitation.py
+(``sanitize_distribution`` at sanitation.py:31, ``sanitize_in`` at :158,
+``sanitize_out`` at :254). Distribution matching in the reference issues
+explicit redistribution comm (dndarray.redistribute_); here it is a
+resharding ``jax.device_put`` the XLA compiler lowers to collectives.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from typing import Optional, Tuple, Union
+
+__all__ = [
+    "sanitize_distribution",
+    "sanitize_in",
+    "sanitize_in_tensor",
+    "sanitize_lshape",
+    "sanitize_out",
+    "sanitize_sequence",
+    "scalar_to_1d",
+]
+
+
+def sanitize_distribution(*args, target, diff_map=None):
+    """Reshard every DNDarray in ``args`` to ``target``'s split layout
+    (reference: sanitation.py:31 redistributes to target.lshape_map; here a
+    sharding change suffices — GSPMD layouts are canonical).
+
+    Returns the single resharded array or a tuple of them.
+    """
+    from .dndarray import DNDarray
+
+    sanitize_in(target)
+    out = []
+    tsplit = target.split
+    for arg in args:
+        sanitize_in(arg)
+        # align split to target's (accounting for broadcast dim offset)
+        new_split = None if tsplit is None else tsplit - (target.ndim - arg.ndim)
+        if (
+            tsplit is None
+            or arg.split is None
+            or new_split < 0
+            or arg.gshape[new_split] == 1
+            or arg.split == new_split
+        ):
+            out.append(arg)
+        else:
+            out.append(arg.resplit(new_split))
+    if len(out) == 1:
+        return out[0]
+    return tuple(out)
+
+
+def sanitize_in(x) -> None:
+    """Verify ``x`` is a DNDarray (reference: sanitation.py:158)."""
+    from .dndarray import DNDarray
+
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+
+
+def sanitize_in_tensor(x) -> None:
+    """Verify ``x`` is a jax array."""
+    import jax
+
+    if not isinstance(x, jax.Array):
+        raise TypeError(f"input needs to be a jax.Array, but was {type(x)}")
+
+
+def sanitize_lshape(array, tensor) -> None:
+    """Verify that a local tensor is a plausible shard of ``array``
+    (reference: sanitation.py:212)."""
+    gshape = array.gshape
+    lshape = tuple(tensor.shape)
+    if len(lshape) != len(gshape):
+        raise ValueError(f"tensor dims {len(lshape)} do not match array dims {len(gshape)}")
+    split = array.split
+    if split is None:
+        if lshape != gshape:
+            raise ValueError(f"tensor shape {lshape} does not match global shape {gshape}")
+        return
+    for i, (ls, gs) in enumerate(zip(lshape, gshape)):
+        if i == split:
+            if ls > gs:
+                raise ValueError(f"local split extent {ls} exceeds global {gs}")
+        elif ls != gs:
+            raise ValueError(f"tensor shape {lshape} incompatible with global shape {gshape}")
+
+
+def sanitize_out(out, output_shape, output_split, output_device, output_comm=None):
+    """Verify that ``out`` is consistent with the expected output
+    (reference: sanitation.py:254). Reshards/rebinds ``out`` metadata where
+    the reference would redistribute.
+    """
+    from .dndarray import DNDarray
+
+    if not isinstance(out, DNDarray):
+        raise TypeError(f"expected out buffer to be a DNDarray, but was {type(out)}")
+    if tuple(out.shape) != tuple(output_shape):
+        raise ValueError(f"Expecting output buffer of shape {tuple(output_shape)}, got {tuple(out.shape)}")
+    return out
+
+
+def sanitize_sequence(seq) -> list:
+    """Check that ``seq`` is a list/tuple and return it as a list
+    (reference: sanitation.py:322)."""
+    if isinstance(seq, list):
+        return seq
+    if isinstance(seq, tuple):
+        return list(seq)
+    raise TypeError(f"seq must be a list or a tuple, got {type(seq)}")
+
+
+def scalar_to_1d(x):
+    """Turn a scalar DNDarray into a 1-D DNDarray with one element
+    (reference: sanitation.py:341)."""
+    from .dndarray import DNDarray
+
+    if x.ndim != 0:
+        return x
+    return DNDarray(
+        x.larray.reshape(1),
+        gshape=(1,),
+        dtype=x.dtype,
+        split=None,
+        device=x.device,
+        comm=x.comm,
+        balanced=True,
+    )

@@ -1,0 +1,159 @@
+"""Trigonometric and hyperbolic functions.
+
+API parity with /root/reference/heat/core/trigonometrics.py (24 exports,
+all pure-local elementwise via ``__local_op`` — sharding preserved, no
+communication).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = [
+    "acos",
+    "acosh",
+    "asin",
+    "asinh",
+    "atan",
+    "atan2",
+    "atanh",
+    "arccos",
+    "arccosh",
+    "arcsin",
+    "arcsinh",
+    "arctan",
+    "arctan2",
+    "arctanh",
+    "cos",
+    "cosh",
+    "deg2rad",
+    "degrees",
+    "rad2deg",
+    "radians",
+    "sin",
+    "sinh",
+    "tan",
+    "tanh",
+]
+
+
+def acos(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise arccosine."""
+    return _operations.__local_op(jnp.arccos, x, out)
+
+
+arccos = acos
+
+
+def acosh(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise inverse hyperbolic cosine."""
+    return _operations.__local_op(jnp.arccosh, x, out)
+
+
+arccosh = acosh
+
+
+def asin(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise arcsine."""
+    return _operations.__local_op(jnp.arcsin, x, out)
+
+
+arcsin = asin
+
+
+def asinh(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise inverse hyperbolic sine."""
+    return _operations.__local_op(jnp.arcsinh, x, out)
+
+
+arcsinh = asinh
+
+
+def atan(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise arctangent."""
+    return _operations.__local_op(jnp.arctan, x, out)
+
+
+arctan = atan
+
+
+def atan2(t1, t2) -> DNDarray:
+    """Quadrant-aware arctangent of t1/t2."""
+    from . import types
+
+    def _op(a, b):
+        if jnp.issubdtype(a.dtype, jnp.integer):
+            a = a.astype(jnp.float32)
+        if jnp.issubdtype(b.dtype, jnp.integer):
+            b = b.astype(jnp.float32)
+        return jnp.arctan2(a, b)
+
+    return _operations.__binary_op(_op, t1, t2)
+
+
+arctan2 = atan2
+
+
+def atanh(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise inverse hyperbolic tangent."""
+    return _operations.__local_op(jnp.arctanh, x, out)
+
+
+arctanh = atanh
+
+
+def cos(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise cosine."""
+    return _operations.__local_op(jnp.cos, x, out)
+
+
+def cosh(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise hyperbolic cosine."""
+    return _operations.__local_op(jnp.cosh, x, out)
+
+
+def deg2rad(x: DNDarray, out=None) -> DNDarray:
+    """Degrees to radians."""
+    return _operations.__local_op(jnp.deg2rad, x, out)
+
+
+radians = deg2rad
+
+
+def rad2deg(x: DNDarray, out=None) -> DNDarray:
+    """Radians to degrees."""
+    return _operations.__local_op(jnp.rad2deg, x, out)
+
+
+degrees = rad2deg
+
+
+def sin(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise sine."""
+    return _operations.__local_op(jnp.sin, x, out)
+
+
+def sinh(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise hyperbolic sine."""
+    return _operations.__local_op(jnp.sinh, x, out)
+
+
+def tan(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise tangent."""
+    return _operations.__local_op(jnp.tan, x, out)
+
+
+def tanh(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise hyperbolic tangent."""
+    return _operations.__local_op(jnp.tanh, x, out)
+
+
+DNDarray.cos = cos
+DNDarray.sin = sin
+DNDarray.tan = tan
+DNDarray.cosh = cosh
+DNDarray.sinh = sinh
+DNDarray.tanh = tanh

@@ -1,0 +1,531 @@
+"""Type system for heat_tpu.
+
+NumPy-style dtype class hierarchy mapped onto JAX dtypes, with a
+torch-like ("intuitive") promotion lattice. API parity with the reference
+type system (/root/reference/heat/core/types.py: ``datatype`` hierarchy at
+types.py:64-414, ``canonical_heat_type`` at :494, ``promote_types`` at :838,
+``result_type`` at :870, ``finfo``/``iinfo`` at :952/:1007), re-designed for
+TPU: the canonical carrier is a ``jax.numpy`` dtype, and ``bfloat16`` /
+``float16`` are first-class members of the lattice (the reference comments
+them out) because they are the native MXU formats.
+"""
+
+from __future__ import annotations
+
+import builtins
+import numpy as np
+import jax.numpy as jnp
+
+from typing import Any, Iterable, Type, Union
+
+__all__ = [
+    "datatype",
+    "number",
+    "integer",
+    "signedinteger",
+    "unsignedinteger",
+    "bool",
+    "bool_",
+    "floating",
+    "int8",
+    "byte",
+    "int16",
+    "short",
+    "int32",
+    "int",
+    "int_",
+    "int64",
+    "long",
+    "uint8",
+    "ubyte",
+    "float16",
+    "half",
+    "bfloat16",
+    "float32",
+    "float",
+    "float_",
+    "float64",
+    "double",
+    "flexible",
+    "complex",
+    "complex64",
+    "cfloat",
+    "csingle",
+    "complex128",
+    "cdouble",
+    "canonical_heat_type",
+    "heat_type_of",
+    "heat_type_is_exact",
+    "heat_type_is_inexact",
+    "heat_type_is_complexfloating",
+    "heat_type_is_realfloating",
+    "issubdtype",
+    "can_cast",
+    "promote_types",
+    "result_type",
+    "iscomplex",
+    "isreal",
+    "finfo",
+    "iinfo",
+]
+
+
+class datatype:
+    """Generic base class for heat_tpu data types.
+
+    Instantiation casts the operand to the respective type, e.g.
+    ``ht.float32(x)`` returns a ``DNDarray`` of dtype float32
+    (reference semantics: types.py:64-156).
+    """
+
+    _jax_type: Any = None
+    _char: str = None
+
+    def __new__(cls, *value, device=None, comm=None):
+        from . import factories
+
+        if cls._jax_type is None:
+            raise TypeError(f"cannot create '{cls}' instances")
+
+        value_count = len(value)
+        if value_count not in (0, 1):
+            raise TypeError(f"function takes at most 1 argument ({value_count} given)")
+        payload = value[0] if value_count else 0
+
+        return factories.array(payload, dtype=cls, device=device, comm=comm)
+
+    @classmethod
+    def jax_type(cls):
+        """The corresponding ``jax.numpy`` dtype."""
+        return cls._jax_type
+
+    # name kept for reference-API familiarity; returns the jax dtype here
+    torch_type = jax_type
+
+    @classmethod
+    def char(cls) -> str:
+        """Single-character type code."""
+        return cls._char
+
+
+class bool(datatype):
+    """1-byte boolean."""
+
+    _jax_type = jnp.bool_
+    _char = "?"
+
+
+class number(datatype):
+    """Abstract base for all numeric types."""
+
+
+class integer(number):
+    """Abstract base for integer types."""
+
+
+class signedinteger(integer):
+    """Abstract base for signed integers."""
+
+
+class int8(signedinteger):
+    _jax_type = jnp.int8
+    _char = "b"
+
+
+class int16(signedinteger):
+    _jax_type = jnp.int16
+    _char = "h"
+
+
+class int32(signedinteger):
+    _jax_type = jnp.int32
+    _char = "i"
+
+
+class int64(signedinteger):
+    _jax_type = jnp.int64
+    _char = "l"
+
+
+class unsignedinteger(integer):
+    """Abstract base for unsigned integers."""
+
+
+class uint8(unsignedinteger):
+    _jax_type = jnp.uint8
+    _char = "B"
+
+
+class floating(number):
+    """Abstract base for floating-point types."""
+
+
+class float16(floating):
+    """IEEE half precision. TPU-first extension over the reference."""
+
+    _jax_type = jnp.float16
+    _char = "e"
+
+
+class bfloat16(floating):
+    """Brain floating point — the native MXU input format.
+
+    Not present in the reference type system; first-class here because
+    matmul/conv throughput on TPU doubles in bf16.
+    """
+
+    _jax_type = jnp.bfloat16
+    _char = "E"
+
+
+class float32(floating):
+    _jax_type = jnp.float32
+    _char = "f"
+
+
+class float64(floating):
+    _jax_type = jnp.float64
+    _char = "d"
+
+
+class flexible(datatype):
+    """Abstract base for types with flexible/variable size."""
+
+
+class complex(number):
+    """Abstract base for complex floating types."""
+
+
+class complex64(complex):
+    _jax_type = jnp.complex64
+    _char = "F"
+
+
+class complex128(complex):
+    _jax_type = jnp.complex128
+    _char = "D"
+
+
+# aliases (reference: types.py:414-428)
+bool_ = bool
+ubyte = uint8
+byte = int8
+short = int16
+int = int32
+int_ = int32
+long = int64
+half = float16
+float = float32
+float_ = float32
+double = float64
+cfloat = complex64
+csingle = complex64
+cdouble = complex128
+
+_complexfloating = (complex64, complex128)
+_inexact = (float16, bfloat16, float32, float64, *_complexfloating)
+_exact = (uint8, int8, int16, int32, int64)
+
+# type mappings for type strings, numpy dtypes and builtin types
+__type_mappings = {
+    # type strings
+    "?": bool,
+    "B": uint8,
+    "b": int8,
+    "h": int16,
+    "i": int32,
+    "l": int64,
+    "e": float16,
+    "E": bfloat16,
+    "f": float32,
+    "d": float64,
+    "F": complex64,
+    "D": complex128,
+    "b1": bool,
+    "u": uint8,
+    "u1": uint8,
+    "i1": int8,
+    "i2": int16,
+    "i4": int32,
+    "i8": int64,
+    "f2": float16,
+    "f4": float32,
+    "f8": float64,
+    "c8": complex64,
+    "c16": complex128,
+    "bfloat16": bfloat16,
+    # numpy scalar types
+    np.bool_: bool,
+    np.uint8: uint8,
+    np.int8: int8,
+    np.int16: int16,
+    np.int32: int32,
+    np.int64: int64,
+    np.float16: float16,
+    np.float32: float32,
+    np.float64: float64,
+    np.complex64: complex64,
+    np.complex128: complex128,
+    # builtins
+    builtins.bool: bool,
+    builtins.int: int32,
+    builtins.float: float32,
+    builtins.complex: complex64,
+}
+
+# numpy-dtype-name → heat type (covers jnp dtypes incl. bfloat16)
+__name_mappings = {
+    "bool": bool,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+
+def canonical_heat_type(a_type: Union[str, Type[datatype], Any]) -> Type[datatype]:
+    """Canonicalize a builtin Python type, type string, numpy/jax dtype or
+    heat type into the canonical heat_tpu type (reference: types.py:494).
+    """
+    # already a heat type
+    try:
+        if issubclass(a_type, datatype):
+            return a_type
+    except TypeError:
+        pass
+
+    mapped = __type_mappings.get(a_type)
+    if mapped is not None:
+        return mapped
+
+    # numpy / jax dtype objects and their string names
+    try:
+        name = np.dtype(a_type).name
+        mapped = __name_mappings.get(name)
+        if mapped is not None:
+            return mapped
+    except TypeError:
+        pass
+
+    raise TypeError(f"data type {a_type} is not understood")
+
+
+def heat_type_of(obj: Any) -> Type[datatype]:
+    """Infer the canonical heat type of an arbitrary object — DNDarray,
+    jax/numpy array, scalar, or (nested) iterable (reference: types.py:567).
+    """
+    # heat arrays / objects exposing dtype
+    dtype = getattr(obj, "dtype", None)
+    if dtype is not None:
+        return canonical_heat_type(dtype)
+
+    if isinstance(obj, (builtins.bool, builtins.int, builtins.float, builtins.complex)):
+        return canonical_heat_type(type(obj))
+
+    if isinstance(obj, str):
+        raise TypeError(f"data type of {obj} is not understood")
+
+    if isinstance(obj, Iterable):
+        for elem in obj:
+            return heat_type_of(elem)
+        raise TypeError(f"data type of empty iterable {obj} is not understood")
+
+    raise TypeError(f"data type of {obj} is not understood")
+
+
+def heat_type_is_exact(ht_dtype: Type[datatype]) -> builtins.bool:
+    """True if ``ht_dtype`` is an integer type."""
+    return ht_dtype in _exact
+
+
+def heat_type_is_inexact(ht_dtype: Type[datatype]) -> builtins.bool:
+    """True if ``ht_dtype`` is floating or complex."""
+    return ht_dtype in _inexact
+
+
+def heat_type_is_realfloating(ht_dtype: Type[datatype]) -> builtins.bool:
+    """True if ``ht_dtype`` is a real floating type."""
+    return ht_dtype in (float16, bfloat16, float32, float64)
+
+
+def heat_type_is_complexfloating(ht_dtype: Type[datatype]) -> builtins.bool:
+    """True if ``ht_dtype`` is complex."""
+    return ht_dtype in _complexfloating
+
+
+def issubdtype(arg1: Any, arg2: Any) -> builtins.bool:
+    """NumPy-style type-hierarchy test on heat types."""
+
+    def _resolve(arg):
+        try:
+            if issubclass(arg, datatype):
+                return arg
+        except TypeError:
+            pass
+        return canonical_heat_type(arg)
+
+    return issubclass(_resolve(arg1), _resolve(arg2))
+
+
+_SAFE_EXTRA = {
+    # "intuitive" additions over numpy-safe: integer → same/larger float,
+    # mirroring torch/XLA semantics (reference: types.py:695 allows int32→float32)
+    (int32, float32),
+    (int64, float32),
+    (int64, float64),
+    (int32, float16),
+    (int32, bfloat16),
+    (int64, float16),
+    (int64, bfloat16),
+    (int32, complex64),
+    (int64, complex64),
+    (int64, complex128),
+}
+
+
+def can_cast(
+    from_: Union[str, Type[datatype], Any],
+    to: Union[str, Type[datatype], Any],
+    casting: str = "intuitive",
+) -> builtins.bool:
+    """Whether a cast between data types can occur per the casting rule
+    (reference: types.py:673). Casting rules: ``no``, ``safe``, ``same_kind``,
+    ``unsafe``, ``intuitive`` (safe plus int→float of the same width).
+    """
+    if not isinstance(casting, str):
+        raise TypeError(f"expected string, found {type(casting)}")
+    if casting not in ("no", "safe", "same_kind", "unsafe", "intuitive"):
+        raise ValueError(f"casting must be one of 'no', 'safe', 'same_kind', 'unsafe', 'intuitive', not {casting}")
+
+    # scalar value-based casting
+    if isinstance(from_, (builtins.int, builtins.float, builtins.complex)) and not isinstance(
+        from_, builtins.bool
+    ):
+        to_t = canonical_heat_type(to)
+        return np.can_cast(from_, np.dtype(to_t.jax_type()))
+
+    from_t = canonical_heat_type(from_)
+    to_t = canonical_heat_type(to)
+
+    if casting == "unsafe":
+        return True
+    if casting == "no":
+        return from_t == to_t
+
+    f_np = np.dtype(np.float32 if from_t is bfloat16 else from_t.jax_type())
+    t_np = np.dtype(np.float32 if to_t is bfloat16 else to_t.jax_type())
+    if casting == "same_kind":
+        return np.can_cast(f_np, t_np, casting="same_kind") or (from_t, to_t) in _SAFE_EXTRA
+    # safe / intuitive
+    safe = np.can_cast(f_np, t_np, casting="safe")
+    if from_t is bfloat16:
+        safe = to_t in (bfloat16, float32, float64, complex64, complex128)
+    if casting == "safe":
+        return safe
+    return safe or (from_t, to_t) in _SAFE_EXTRA
+
+
+def promote_types(
+    type1: Union[str, Type[datatype], Any], type2: Union[str, Type[datatype], Any]
+) -> Type[datatype]:
+    """Smallest type to which both may be safely cast, following the
+    JAX/torch lattice (int ∨ float → that float), not NumPy's value-widening
+    (reference: types.py:838 uses torch.promote_types — same semantics).
+    """
+    t1 = canonical_heat_type(type1)
+    t2 = canonical_heat_type(type2)
+    return canonical_heat_type(jnp.promote_types(t1.jax_type(), t2.jax_type()))
+
+
+def result_type(*arrays_and_types: Any) -> Type[datatype]:
+    """Resulting type from applying the promotion lattice over all operands
+    (arrays, heat types, scalars) (reference: types.py:870). Python scalars
+    participate as weak types (int + float32-array stays float32); jnp's
+    lattice handles bfloat16 natively (bf16 ∨ f16 → f32).
+    """
+    if not arrays_and_types:
+        raise ValueError("at least one array or dtype is required")
+
+    def _to_jax_operand(obj):
+        dtype = getattr(obj, "dtype", None)
+        if dtype is not None:
+            # arrays participate with their (strong) dtype
+            return np.dtype(canonical_heat_type(dtype).jax_type())
+        if isinstance(obj, (builtins.bool, builtins.int, builtins.float, builtins.complex)):
+            return obj  # weak scalar
+        return np.dtype(canonical_heat_type(obj).jax_type())
+
+    return canonical_heat_type(jnp.result_type(*(_to_jax_operand(o) for o in arrays_and_types)))
+
+
+def iscomplex(x):
+    """Elementwise test for non-zero imaginary part (reference: complex_math)."""
+    from . import _operations
+
+    def _local(a):
+        if jnp.iscomplexobj(a):
+            return jnp.imag(a) != 0
+        return jnp.zeros(a.shape, dtype=jnp.bool_)
+
+    return _operations.__local_op(_local, x, None, no_cast=True)
+
+
+def isreal(x):
+    """Elementwise test for zero imaginary part."""
+    from . import _operations
+
+    def _local(a):
+        if jnp.iscomplexobj(a):
+            return jnp.imag(a) == 0
+        return jnp.ones(a.shape, dtype=jnp.bool_)
+
+    return _operations.__local_op(_local, x, None, no_cast=True)
+
+
+class finfo:
+    """Machine limits for floating point types (reference: types.py:952)."""
+
+    def __new__(cls, dtype: Type[datatype]):
+        try:
+            dtype = canonical_heat_type(dtype)
+        except TypeError:
+            raise TypeError(f"data type {dtype} not inexact, not supported")
+        if dtype not in _inexact:
+            raise TypeError(f"data type {dtype} not inexact, not supported")
+        return super().__new__(cls)._init(dtype)
+
+    def _init(self, dtype):
+        info = jnp.finfo(dtype.jax_type())
+        self.bits = info.bits
+        self.eps = builtins.float(info.eps)
+        self.max = builtins.float(info.max)
+        self.min = builtins.float(info.min)
+        self.tiny = builtins.float(info.tiny)
+        return self
+
+
+class iinfo:
+    """Machine limits for integer types (reference: types.py:1007)."""
+
+    def __new__(cls, dtype: Type[datatype]):
+        try:
+            dtype = canonical_heat_type(dtype)
+        except TypeError:
+            raise TypeError(f"data type {dtype} not exact, not supported")
+        if dtype not in (*_exact, bool):
+            raise TypeError(f"data type {dtype} not exact, not supported")
+        return super().__new__(cls)._init(dtype)
+
+    def _init(self, dtype):
+        info = jnp.iinfo(dtype.jax_type())
+        self.bits = info.bits
+        self.max = builtins.int(info.max)
+        self.min = builtins.int(info.min)
+        return self

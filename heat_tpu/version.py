@@ -1,0 +1,19 @@
+"""Version information for heat_tpu.
+
+Mirrors the role of ``heat/core/version.py`` in the reference
+(/root/reference/heat/core/version.py): single source of the package version.
+"""
+
+major: int = 0
+"""Major version (API-incompatible changes)."""
+minor: int = 1
+"""Minor version (backward-compatible features)."""
+micro: int = 0
+"""Micro version (bug fixes)."""
+extension: str = "dev"
+"""Pre-release tag."""
+
+if not extension:
+    __version__: str = f"{major}.{minor}.{micro}"
+else:
+    __version__: str = f"{major}.{minor}.{micro}-{extension}"

@@ -1,0 +1,18 @@
+"""Test configuration: run the suite on a virtual 8-device CPU mesh.
+
+The analog of the reference's ``mpirun -n N pytest heat/`` CI runs
+(/root/reference/.github/workflows/ci.yaml:54-56): multi-device behavior is
+exercised without hardware by forcing N host platform devices. Must run
+before any jax backend initialization.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,171 @@
+"""End-to-end smoke tests: the reference's scripts/heat_test.py workload
+(``ht.arange(N, split=0).sum()`` — SURVEY.md §3.1) plus basic factory/op/
+distribution sanity across splits on the 8-device CPU mesh."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+class TestSmoke(TestCase):
+    def test_mesh(self):
+        self.assertEqual(self.comm.size, 8)
+
+    def test_arange_sum(self):
+        # reference smoke test: scripts/heat_test.py
+        a = ht.arange(2 * 3 * 4, split=0)
+        self.assertEqual(a.split, 0)
+        self.assertEqual(a.shape, (24,))
+        s = a.sum()
+        self.assertIsInstance(s, ht.DNDarray)
+        self.assertEqual(s.split, None)
+        self.assertEqual(int(s), 276)
+
+    def test_arange_parity(self):
+        self.assert_array_equal(ht.arange(10, split=0), np.arange(10, dtype=np.int32))
+        self.assert_array_equal(ht.arange(1, 7, 2), np.arange(1, 7, 2, dtype=np.int32))
+        self.assert_array_equal(
+            ht.arange(0.0, 1.0, 0.1, split=0), np.arange(0.0, 1.0, 0.1, dtype=np.float32)
+        )
+
+    def test_factories_parity(self):
+        for split in (None, 0, 1):
+            self.assert_array_equal(ht.zeros((7, 5), split=split), np.zeros((7, 5), np.float32))
+            self.assert_array_equal(ht.ones((7, 5), split=split), np.ones((7, 5), np.float32))
+            self.assert_array_equal(
+                ht.full((7, 5), 3.5, split=split), np.full((7, 5), 3.5, np.float32)
+            )
+        self.assert_array_equal(ht.eye(4, split=0), np.eye(4, dtype=np.float32))
+        self.assert_array_equal(
+            ht.linspace(0, 1, 11, split=0), np.linspace(0, 1, 11).astype(np.float32)
+        )
+
+    def test_array_from_data(self):
+        data = np.random.randn(9, 4).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            self.assertEqual(x.split, split)
+            self.assert_array_equal(x, data)
+        # dtype inference from python scalars stays canonical (float32/int32)
+        self.assertEqual(ht.array([1.0, 2.0]).dtype, ht.float32)
+        self.assertEqual(ht.array([1, 2]).dtype, ht.int32)
+        self.assertEqual(ht.array(True).dtype, ht.bool)
+
+    def test_binary_ops_mixed_splits(self):
+        a_np = np.random.randn(8, 6).astype(np.float32)
+        b_np = np.random.randn(8, 6).astype(np.float32)
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                a = ht.array(a_np, split=sa)
+                b = ht.array(b_np, split=sb)
+                c = a + b
+                np.testing.assert_allclose(c.numpy(), a_np + b_np, rtol=1e-6)
+        # scalar ops preserve dtype
+        x = ht.ones((4,), dtype=ht.float32, split=0)
+        self.assertEqual((x + 1).dtype, ht.float32)
+        self.assertEqual((x * 2.0).dtype, ht.float32)
+
+    def test_reductions(self):
+        data = np.random.randn(6, 8, 4).astype(np.float32)
+        for split in (None, 0, 1, 2):
+            x = ht.array(data, split=split)
+            self.assert_array_equal(x.sum(axis=0), data.sum(axis=0))
+            self.assert_array_equal(x.sum(axis=1), data.sum(axis=1))
+            self.assert_array_equal(x.sum(axis=(0, 2)), data.sum(axis=(0, 2)))
+            np.testing.assert_allclose(float(x.sum()), data.sum(), rtol=1e-4)
+            self.assert_array_equal(
+                x.sum(axis=1, keepdims=True), data.sum(axis=1, keepdims=True)
+            )
+
+    def test_resplit(self):
+        data = np.arange(24, dtype=np.float32).reshape(6, 4)
+        x = ht.array(data, split=0)
+        y = x.resplit(1)
+        self.assertEqual(y.split, 1)
+        self.assert_array_equal(y, data)
+        x.resplit_(None)
+        self.assertEqual(x.split, None)
+        self.assert_array_equal(x, data)
+        x.resplit_(1)
+        self.assertEqual(x.split, 1)
+        self.assert_array_equal(x, data)
+
+    def test_lshape_map(self):
+        x = ht.zeros((10, 4), split=0)
+        lmap = x.lshape_map
+        self.assertEqual(lmap.shape, (8, 2))
+        self.assertEqual(lmap[:, 0].sum(), 10)
+        # ceil-division convention: first shards have 2 rows
+        self.assertEqual(lmap[0, 0], 2)
+
+    def test_getitem_setitem(self):
+        data = np.arange(48, dtype=np.float32).reshape(8, 6)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            np.testing.assert_allclose(x[2].numpy(), data[2])
+            np.testing.assert_allclose(x[1:5].numpy(), data[1:5])
+            np.testing.assert_allclose(x[:, 2:4].numpy(), data[:, 2:4])
+            np.testing.assert_allclose(x[3, 4].numpy(), data[3, 4])
+            y = ht.array(data, split=split)
+            y[0] = 0.0
+            expected = data.copy()
+            expected[0] = 0.0
+            np.testing.assert_allclose(y.numpy(), expected)
+
+    def test_item_and_scalar_conversion(self):
+        x = ht.array([[5.0]], split=0)
+        self.assertEqual(x.item(), 5.0)
+        self.assertEqual(float(x), 5.0)
+        self.assertEqual(int(x), 5)
+
+    def test_astype(self):
+        x = ht.arange(10, split=0)
+        y = x.astype(ht.float64)
+        self.assertEqual(y.dtype, ht.float64)
+        self.assert_array_equal(y, np.arange(10, dtype=np.float64))
+
+    def test_promotion(self):
+        self.assertEqual(ht.promote_types(ht.int32, ht.float32), ht.float32)
+        self.assertEqual(ht.promote_types(ht.int64, ht.float32), ht.float32)
+        self.assertEqual(ht.promote_types(ht.uint8, ht.int8), ht.int16)
+        self.assertEqual(ht.promote_types(ht.bfloat16, ht.float32), ht.float32)
+        x = ht.ones((3,), dtype=ht.int32)
+        y = ht.ones((3,), dtype=ht.float32)
+        self.assertEqual((x + y).dtype, ht.float32)
+
+    def test_elementwise_parity(self):
+        self.assert_func_equal((5, 5), ht.exp, np.exp, data_types=(np.float32,))
+        self.assert_func_equal((5, 5), ht.sin, np.sin, data_types=(np.float32,))
+        self.assert_func_equal((5, 5), ht.sqrt, np.abs, data_types=())  # no-op guard
+        data = np.random.rand(5, 5).astype(np.float32) + 0.1
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            self.assert_array_equal(ht.sqrt(x), np.sqrt(data))
+            self.assert_array_equal(ht.log(x), np.log(data))
+
+    def test_trig_int_promotes(self):
+        x = ht.arange(5, split=0)
+        y = ht.sin(x)
+        self.assertEqual(y.dtype, ht.float32)
+
+    def test_repr(self):
+        x = ht.arange(5, split=0)
+        s = str(x)
+        self.assertIn("DNDarray", s)
+        self.assertIn("dtype=ht.int32", s)
+        self.assertIn("split=0", s)
+
+    def test_bfloat16_extension(self):
+        x = ht.ones((4, 4), dtype=ht.bfloat16, split=0)
+        self.assertEqual(x.dtype, ht.bfloat16)
+        self.assertEqual(x.nbytes, 32)
+        y = x @ x
+        np.testing.assert_allclose(y.numpy(), np.full((4, 4), 4.0), rtol=1e-2)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

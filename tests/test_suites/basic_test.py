@@ -1,0 +1,125 @@
+"""Shared test base class.
+
+The analog of the reference's ``TestCase``
+(/root/reference/heat/core/tests/test_suites/basic_test.py:12):
+``assert_array_equal(heat_array, expected)`` verifies the global result
+against a NumPy oracle AND checks every device shard against the
+corresponding slice of the oracle — the single-controller equivalent of
+"each MPI rank's local tensor matches its numpy slice"
+(reference basic_test.py:65-120). ``assert_func_equal`` applies a function
+via heat_tpu and numpy over several splits.
+"""
+
+import unittest
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+class TestCase(unittest.TestCase):
+    __comm = None
+    __device = None
+
+    @property
+    def comm(self):
+        if TestCase.__comm is None:
+            TestCase.__comm = ht.get_comm()
+        return TestCase.__comm
+
+    @property
+    def device(self):
+        if TestCase.__device is None:
+            TestCase.__device = ht.get_device()
+        return TestCase.__device
+
+    def get_rank(self):
+        return self.comm.rank
+
+    def get_size(self):
+        return self.comm.size
+
+    def assert_array_equal(self, heat_array, expected_array, rtol=1e-5, atol=1e-8):
+        """Global result matches the oracle; every device shard matches its
+        slice of the oracle."""
+        self.assertIsInstance(
+            heat_array, ht.DNDarray, f"The array to test was not a DNDarray, but {type(heat_array)}"
+        )
+        expected_array = np.asarray(expected_array)
+        self.assertEqual(
+            tuple(heat_array.shape),
+            tuple(expected_array.shape),
+            f"Global shapes do not match: {heat_array.shape} != {expected_array.shape}",
+        )
+
+        got = heat_array.numpy()
+        if np.issubdtype(expected_array.dtype, np.floating) or np.issubdtype(
+            expected_array.dtype, np.complexfloating
+        ):
+            np.testing.assert_allclose(got, expected_array, rtol=rtol, atol=atol)
+        else:
+            np.testing.assert_array_equal(got, expected_array)
+
+        # shard-level check: each device's (physical) shard equals the
+        # oracle slice from the chunk geometry, pad rows excluded
+        split = heat_array.split
+        if split is not None:
+            comm = heat_array.comm
+            shards_by_device = {
+                id(sh.device): sh for sh in heat_array._phys.addressable_shards
+            }
+            for r, dev in enumerate(comm.devices):
+                shard = shards_by_device.get(id(dev))
+                if shard is None:
+                    continue
+                _, lshape, slices = comm.chunk(heat_array.shape, split, rank=r)
+                shard_np = np.asarray(shard.data)
+                if shard_np.dtype.kind not in "biufc":
+                    shard_np = shard_np.astype(np.float32)
+                valid = [slice(0, int(e)) for e in lshape]
+                shard_np = shard_np[tuple(valid)]
+                expected_slice = expected_array[slices]
+                if np.issubdtype(expected_array.dtype, np.floating):
+                    np.testing.assert_allclose(
+                        shard_np, expected_slice, rtol=rtol, atol=atol,
+                        err_msg=f"shard {r} does not match oracle slice {slices}",
+                    )
+                else:
+                    np.testing.assert_array_equal(shard_np, expected_slice)
+
+    def assert_func_equal(
+        self,
+        shape,
+        heat_func,
+        numpy_func,
+        distributed_result=True,
+        heat_args=None,
+        numpy_args=None,
+        data_types=(np.int32, np.int64, np.float32, np.float64),
+        low=-10000,
+        high=10000,
+    ):
+        """Apply the same function via heat_tpu and numpy over all splits
+        (reference basic_test.py assert_func_equal)."""
+        heat_args = heat_args or {}
+        numpy_args = numpy_args or {}
+        if not isinstance(shape, (tuple, list)):
+            raise ValueError(f"shape must be tuple or list, got {type(shape)}")
+
+        for dtype in data_types:
+            if np.issubdtype(dtype, np.floating):
+                np_array = np.random.randn(*shape).astype(dtype)
+            else:
+                np_array = np.random.randint(low=low, high=high, size=shape, dtype=dtype)
+            expected = numpy_func(np_array.copy(), **numpy_args)
+            for split in [None] + list(range(len(shape))):
+                ht_array = ht.array(np_array, split=split)
+                result = heat_func(ht_array, **heat_args)
+                if isinstance(result, ht.DNDarray):
+                    self.assert_array_equal(result, expected)
+                else:
+                    np.testing.assert_allclose(np.asarray(result), expected, rtol=1e-5)
+
+    def assertTrue_memory_layout(self, tensor, order):
+        # XLA owns physical layout on TPU; nothing to assert
+        return True
