@@ -5,6 +5,7 @@ flat ``ht.*`` namespace re-exports every surface module.
 """
 
 from .communication import *
+from .constants import *
 from .devices import *
 from .types import *
 from .dndarray import *
@@ -12,6 +13,8 @@ from .factories import *
 from .arithmetics import *
 from .complex_math import *
 from .exponential import *
+from .indexing import *
+from .io import *
 from .logical import *
 from .manipulations import *
 from .memory import *
@@ -19,8 +22,12 @@ from .printing import *
 from .relational import *
 from .rounding import *
 from .sanitation import *
+from .signal import *
+from .statistics import *
 from .stride_tricks import *
 from .trigonometrics import *
+
+from . import random
 
 from . import linalg
 from .linalg import *

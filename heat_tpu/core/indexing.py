@@ -1,0 +1,62 @@
+"""Indexing functions.
+
+API parity with /root/reference/heat/core/indexing.py (``nonzero``,
+``where``). ``nonzero`` in the reference returns a split=0 result of the
+local nonzero plus rank offsets (indexing.py nonzero); the output shape is
+data-dependent, so it is evaluated eagerly here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from . import _operations
+from .dndarray import DNDarray
+from .sanitation import sanitize_in
+
+__all__ = ["nonzero", "where"]
+
+
+def nonzero(x: DNDarray) -> DNDarray:
+    """Indices of non-zero elements as an (nnz, ndim) array, split=0 when
+    x is distributed (reference: indexing.py nonzero)."""
+    sanitize_in(x)
+    idx = jnp.nonzero(x.larray)
+    stacked = jnp.stack(idx, axis=1) if x.ndim > 0 else jnp.zeros((0, 0), dtype=jnp.int64)
+    stacked = stacked.astype(jnp.int64)
+    split = 0 if x.split is not None else None
+    gshape = tuple(int(s) for s in stacked.shape)
+    if split is not None:
+        stacked = x.comm.shard(stacked, split)
+    return DNDarray(stacked, gshape, types.int64, split, x.device, x.comm)
+
+
+def where(cond: DNDarray, x=None, y=None) -> DNDarray:
+    """Ternary where / nonzero (reference: indexing.py where)."""
+    if x is None and y is None:
+        return nonzero(cond)
+    if x is None or y is None:
+        raise TypeError("either both or neither of x and y should be given")
+    sanitize_in(cond)
+    x_t = x if isinstance(x, DNDarray) else None
+    y_t = y if isinstance(y, DNDarray) else None
+    promoted = types.result_type(x, y)
+    jt = promoted.jax_type()
+    xv = x.larray.astype(jt) if isinstance(x, DNDarray) else x
+    yv = y.larray.astype(jt) if isinstance(y, DNDarray) else y
+    result = jnp.where(cond.larray, xv, yv)
+    split = cond.split
+    if split is None:
+        for t in (x_t, y_t):
+            if t is not None and t.split is not None and t.ndim == result.ndim:
+                split = t.split
+                break
+    gshape = tuple(int(s) for s in result.shape)
+    if split is not None and split < result.ndim:
+        result = cond.comm.shard(result, split)
+    else:
+        split = None
+    return DNDarray(
+        result, gshape, types.canonical_heat_type(result.dtype), split, cond.device, cond.comm
+    )

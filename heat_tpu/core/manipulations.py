@@ -356,6 +356,9 @@ def reshape(a: DNDarray, *shape, **kwargs) -> DNDarray:
         raise TypeError(f"reshape got unexpected keyword arguments {list(kwargs)}")
     if new_split is None:
         new_split = a.split
+        if new_split is not None and new_split >= len(shape):
+            # fewer output dims than the old split axis: clamp to the last
+            new_split = len(shape) - 1
     new_split = sanitize_axis(shape, new_split)
     result = jnp.reshape(a.larray, shape)
     return _wrap(result, new_split, a, dtype=a.dtype)

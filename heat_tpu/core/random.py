@@ -1,0 +1,255 @@
+"""Pseudo-random number generation.
+
+API parity with /root/reference/heat/core/random.py (15 exports). The
+reference hand-implements a counter-based Threefry-2x32/64 cipher in torch
+ops (``__threefry32/64`` random.py:874/:976) precisely so that results are
+reproducible regardless of the number of MPI ranks (``__counter_sequence``
+:55-198 gives each rank its slice of the global 128-bit counter stream).
+JAX's native PRNG *is* counter-based Threefry — the design the reference
+emulates — so this module is a thin stateful wrapper over ``jax.random``:
+a global (seed, counter) pair advances per draw, giving the same
+sequence-stability guarantee for free, independent of mesh size.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from typing import Optional, Tuple, Type, Union
+
+from . import types
+from .communication import Communication, sanitize_comm
+from .devices import Device, sanitize_device
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "get_state",
+    "normal",
+    "permutation",
+    "rand",
+    "ranf",
+    "randint",
+    "random_integer",
+    "randn",
+    "random",
+    "random_sample",
+    "randperm",
+    "sample",
+    "seed",
+    "set_state",
+    "standard_normal",
+]
+
+# global PRNG state: (seed, counter) — the analog of the reference's
+# __seed/__counter globals (random.py:40-52)
+__seed: int = None
+__counter: int = 0
+
+
+def __init_seed() -> None:
+    global __seed, __counter
+    if __seed is None:
+        import time
+
+        __seed = int(time.time() * 1000) % (2**32)
+        __counter = 0
+
+
+def _next_key(numel: int) -> jax.Array:
+    """Fold the current counter into the seed key and advance the counter
+    by the number of elements drawn (the reference's counter-slice logic,
+    random.py:55-198, without the per-rank arithmetic)."""
+    global __counter
+    __init_seed()
+    key = jax.random.fold_in(jax.random.PRNGKey(__seed), __counter % (2**31))
+    __counter += int(numel)
+    return key
+
+
+def _wrap(values: jax.Array, dtype, split, device, comm) -> DNDarray:
+    device = sanitize_device(device)
+    comm = sanitize_comm(comm)
+    split = sanitize_axis(values.shape, split)
+    gshape = tuple(int(s) for s in values.shape)
+    values = comm.shard(values, split)
+    return DNDarray(values, gshape, dtype, split, device, comm)
+
+
+def get_state() -> Tuple[str, int, int, int, float]:
+    """Return the internal state of the generator (reference:
+    random.py get_state): ('Threefry', seed, counter, 0, 0.0)."""
+    __init_seed()
+    return ("Threefry", __seed, __counter, 0, 0.0)
+
+
+def set_state(state: Tuple[str, int, int, int, float]) -> None:
+    """Set the internal state (reference: random.py set_state)."""
+    global __seed, __counter
+    if not isinstance(state, tuple) or len(state) not in (3, 5):
+        raise ValueError("state needs to be a 3- or 5-tuple")
+    if state[0] != "Threefry":
+        raise ValueError("algorithm must be 'Threefry'")
+    __seed = int(state[1])
+    __counter = int(state[2])
+
+
+def seed(seed: Optional[int] = None) -> None:
+    """Seed the generator (reference: random.py seed)."""
+    global __seed, __counter
+    if seed is None:
+        import time
+
+        seed = int(time.time() * 1000) % (2**32)
+    __seed = int(seed)
+    __counter = 0
+
+
+def normal(
+    mean=0.0,
+    std=1.0,
+    shape: Optional[Tuple[int, ...]] = None,
+    dtype: Type[types.datatype] = types.float32,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Normal distribution with given mean and std (reference: random.py
+    normal; Kundu transform at random.py:246 — jax.random.normal here)."""
+    if shape is None:
+        shape = getattr(mean, "shape", None) or getattr(std, "shape", None) or ()
+    shape = sanitize_shape(shape) if shape != () else ()
+    dtype = types.canonical_heat_type(dtype)
+    if dtype not in (types.float16, types.bfloat16, types.float32, types.float64):
+        raise ValueError("dtype must be a float type")
+    numel = int(np.prod(shape)) if shape else 1
+    key = _next_key(numel)
+    base = jax.random.normal(key, shape, dtype=dtype.jax_type())
+    m = mean.larray if isinstance(mean, DNDarray) else mean
+    s = std.larray if isinstance(std, DNDarray) else std
+    values = base * s + m
+    return _wrap(values, dtype, split, device, comm)
+
+
+def permutation(x) -> DNDarray:
+    """Random permutation of arange(n) or shuffle of a copy of x along
+    axis 0 (reference: random.py permutation)."""
+    if isinstance(x, (int, np.integer)):
+        return randperm(int(x))
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected int or DNDarray, got {type(x)}")
+    key = _next_key(x.shape[0] if x.ndim else 1)
+    perm = jax.random.permutation(key, x.shape[0])
+    values = jnp.take(x.larray, perm, axis=0)
+    return _wrap(values, x.dtype, x.split, x.device, x.comm)
+
+
+def rand(
+    *args,
+    dtype: Type[types.datatype] = types.float32,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Uniform [0, 1) samples of the given shape (reference: random.py
+    rand)."""
+    shape = sanitize_shape(args) if args else ()
+    dtype = types.canonical_heat_type(dtype)
+    if dtype not in (types.float16, types.bfloat16, types.float32, types.float64):
+        raise ValueError(f"dtype must be a float type, got {dtype}")
+    numel = int(np.prod(shape)) if shape else 1
+    key = _next_key(numel)
+    values = jax.random.uniform(key, shape, dtype=dtype.jax_type())
+    return _wrap(values, dtype, split, device, comm)
+
+
+def randint(
+    low: int,
+    high: Optional[int] = None,
+    size: Optional[Union[int, Tuple[int, ...]]] = None,
+    dtype: Optional[Type[types.datatype]] = types.int32,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Random integers in [low, high) (reference: random.py randint)."""
+    if high is None:
+        low, high = 0, low
+    if size is None:
+        size = ()
+    shape = sanitize_shape(size) if size != () else ()
+    if low >= high:
+        raise ValueError(f"low >= high ({low} >= {high})")
+    dtype = types.canonical_heat_type(dtype if dtype is not None else types.int32)
+    if dtype not in (types.int8, types.int16, types.int32, types.int64, types.uint8):
+        raise ValueError(f"dtype must be an integer type, got {dtype}")
+    numel = int(np.prod(shape)) if shape else 1
+    key = _next_key(numel)
+    values = jax.random.randint(key, shape, low, high, dtype=dtype.jax_type())
+    return _wrap(values, dtype, split, device, comm)
+
+
+random_integer = randint
+
+
+def randn(
+    *args,
+    dtype: Type[types.datatype] = types.float32,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Standard-normal samples of the given shape (reference: random.py
+    randn)."""
+    shape = sanitize_shape(args) if args else ()
+    dtype = types.canonical_heat_type(dtype)
+    if dtype not in (types.float16, types.bfloat16, types.float32, types.float64):
+        raise ValueError(f"dtype must be a float type, got {dtype}")
+    numel = int(np.prod(shape)) if shape else 1
+    key = _next_key(numel)
+    values = jax.random.normal(key, shape, dtype=dtype.jax_type())
+    return _wrap(values, dtype, split, device, comm)
+
+
+def random(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0, 1) samples (reference: random.py random)."""
+    return random_sample(shape, dtype, split, device, comm)
+
+
+def random_sample(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0, 1) samples (reference: random.py random_sample)."""
+    if shape is None:
+        shape = (1,)
+    shape = sanitize_shape(shape)
+    return rand(*shape, dtype=dtype, split=split, device=device, comm=comm)
+
+
+ranf = random_sample
+sample = random_sample
+
+
+def randperm(
+    n: int,
+    dtype: Type[types.datatype] = types.int64,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Random permutation of arange(n) (reference: random.py randperm)."""
+    if not isinstance(n, (int, np.integer)):
+        raise TypeError(f"n must be an integer, got {type(n)}")
+    dtype = types.canonical_heat_type(dtype)
+    key = _next_key(n)
+    values = jax.random.permutation(key, int(n)).astype(dtype.jax_type())
+    return _wrap(values, dtype, split, device, comm)
+
+
+def standard_normal(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Standard-normal samples (reference: random.py standard_normal)."""
+    if shape is None:
+        shape = (1,)
+    shape = sanitize_shape(shape)
+    return randn(*shape, dtype=dtype, split=split, device=device, comm=comm)

@@ -1,0 +1,61 @@
+"""Signal processing: distributed convolution.
+
+API parity with /root/reference/heat/core/signal.py (``convolve``). The
+reference implements 1-D convolution by exchanging halos of size
+``v.size//2`` between neighboring ranks (signal.py:125-127: ``get_halo`` +
+``array_with_halos``) followed by a local conv1d — the canonical stencil
+pattern. On TPU the sharded ``lax.conv_general_dilated`` makes XLA emit
+exactly that edge exchange (a collective-permute of the boundary) itself.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import types
+from .dndarray import DNDarray
+from .sanitation import sanitize_in
+
+__all__ = ["convolve"]
+
+
+def convolve(a: DNDarray, v: DNDarray, mode: str = "full") -> DNDarray:
+    """1-D convolution of ``a`` with kernel ``v`` (reference:
+    signal.py convolve; modes full/same/valid)."""
+    from . import factories
+
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    if not isinstance(v, DNDarray):
+        v = factories.array(v)
+    if a.ndim != 1 or v.ndim != 1:
+        raise ValueError("only 1-dimensional input arrays are allowed")
+    if mode not in ("full", "same", "valid"):
+        raise ValueError(f"unsupported mode {mode!r}, use full/same/valid")
+    if mode == "same" and v.shape[0] % 2 == 0:
+        raise ValueError("mode 'same' cannot be used with even-sized kernel")
+    if a.shape[0] < v.shape[0]:
+        a, v = v, a
+
+    promoted = types.promote_types(a.dtype, v.dtype)
+    if types.heat_type_is_exact(promoted):
+        compute = types.promote_types(promoted, types.float32)
+    else:
+        compute = promoted
+    arr = a.larray.astype(compute.jax_type())
+    ker = v.larray.astype(compute.jax_type())
+
+    result = jnp.convolve(arr, ker, mode=mode)
+    if types.heat_type_is_exact(promoted):
+        result = jnp.round(result).astype(promoted.jax_type())
+
+    split = a.split
+    gshape = tuple(int(s) for s in result.shape)
+    if split is not None:
+        result = a.comm.shard(result, split)
+    return DNDarray(
+        result, gshape, types.canonical_heat_type(result.dtype), split, a.device, a.comm
+    )

@@ -1,0 +1,92 @@
+"""Tests for indexing (nonzero/where), signal (convolve), io (hdf5/csv)."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+class TestIndexing(TestCase):
+    def test_nonzero(self):
+        d = np.array([[0, 1, 0], [2, 0, 3]], dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(d, split=split)
+            got = ht.nonzero(x)
+            expected = np.stack(np.nonzero(d), axis=1)
+            np.testing.assert_array_equal(got.numpy(), expected)
+
+    def test_where(self):
+        d = np.random.randn(5, 6).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(d, split=split)
+            got = ht.where(x > 0, x, 0.0)
+            np.testing.assert_allclose(got.numpy(), np.where(d > 0, d, 0.0))
+            got2 = ht.where(x > 0, 1.0, -1.0)
+            np.testing.assert_allclose(got2.numpy(), np.where(d > 0, 1.0, -1.0))
+        with self.assertRaises(TypeError):
+            ht.where(x > 0, x)
+
+
+class TestSignal(TestCase):
+    def test_convolve(self):
+        sig = np.random.randn(50).astype(np.float32)
+        ker = np.random.randn(5).astype(np.float32)
+        for split in (None, 0):
+            a = ht.array(sig, split=split)
+            v = ht.array(ker)
+            for mode in ("full", "same", "valid"):
+                got = ht.convolve(a, v, mode=mode)
+                np.testing.assert_allclose(got.numpy(), np.convolve(sig, ker, mode=mode), rtol=1e-4)
+
+    def test_convolve_int(self):
+        sig = np.arange(16)
+        ker = [1, 1, 1]
+        got = ht.convolve(ht.array(sig, split=0, dtype=ht.int32), ht.array(ker, dtype=ht.int32))
+        np.testing.assert_array_equal(got.numpy(), np.convolve(sig, ker))
+
+    def test_convolve_errors(self):
+        with self.assertRaises(ValueError):
+            ht.convolve(ht.ones((3, 3)), ht.ones(2))
+        with self.assertRaises(ValueError):
+            ht.convolve(ht.ones(10), ht.ones(4), mode="same")
+
+
+class TestIO(TestCase):
+    def test_hdf5_roundtrip(self):
+        self.assertTrue(ht.supports_hdf5())
+        d = np.random.randn(16, 8).astype(np.float32)
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "data.h5")
+            x = ht.array(d, split=0)
+            ht.save(x, path, "data")
+            for split in (None, 0, 1):
+                y = ht.load(path, "data", split=split)
+                self.assertEqual(y.split, split)
+                np.testing.assert_allclose(y.numpy(), d, rtol=1e-6)
+            y = ht.load_hdf5(path, "data", split=0)
+            np.testing.assert_allclose(y.numpy(), d, rtol=1e-6)
+
+    def test_csv_roundtrip(self):
+        d = np.random.randn(10, 4).astype(np.float32)
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "data.csv")
+            ht.save_csv(ht.array(d, split=0), path, decimals=6)
+            y = ht.load_csv(path, split=0)
+            np.testing.assert_allclose(y.numpy(), d, rtol=1e-4, atol=1e-5)
+
+    def test_load_unknown_extension(self):
+        with self.assertRaises(ValueError):
+            ht.load("file.xyz")
+
+    def test_netcdf_gated(self):
+        self.assertFalse(ht.supports_netcdf())
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

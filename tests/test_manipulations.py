@@ -1,0 +1,140 @@
+"""Manipulations parity tests vs NumPy across splits (reference:
+core/tests/test_manipulations.py pattern: iterate splits × shapes)."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+class TestManipulations(TestCase):
+    def setUp(self):
+        np.random.seed(0)
+        self.d2 = np.random.randn(6, 8).astype(np.float32)
+        self.d3 = np.random.randn(4, 6, 5).astype(np.float32)
+
+    def test_concatenate_stack(self):
+        d = self.d2
+        for sa in (None, 0, 1):
+            for sb in (None, 0):
+                a = ht.array(d, split=sa)
+                b = ht.array(d, split=sb)
+                self.assert_array_equal(ht.concatenate([a, b], axis=0), np.concatenate([d, d], 0))
+                self.assert_array_equal(ht.concatenate([a, b], axis=1), np.concatenate([d, d], 1))
+        a = ht.array(d, split=0)
+        st = ht.stack([a, a], axis=0)
+        np.testing.assert_allclose(st.numpy(), np.stack([d, d], 0))
+        self.assertEqual(st.split, 1)
+        self.assert_array_equal(ht.vstack([a, a]), np.vstack([d, d]))
+        self.assert_array_equal(ht.hstack([a, a]), np.hstack([d, d]))
+
+    def test_reshape(self):
+        d = self.d2
+        for split in (None, 0, 1):
+            x = ht.array(d, split=split)
+            self.assert_array_equal(x.reshape(8, 6), d.reshape(8, 6))
+            self.assert_array_equal(x.reshape(-1), d.reshape(-1))
+            self.assert_array_equal(x.reshape(2, 2, 12), d.reshape(2, 2, 12))
+        # new_split kwarg (reference manipulations.py:1994)
+        x = ht.array(d, split=0)
+        y = ht.reshape(x, (8, 6), new_split=1)
+        self.assertEqual(y.split, 1)
+        np.testing.assert_allclose(y.numpy(), d.reshape(8, 6))
+        with self.assertRaises(ValueError):
+            x.reshape(5, 5)
+
+    def test_sort(self):
+        d = self.d2
+        for split in (None, 0, 1):
+            x = ht.array(d, split=split)
+            for axis in (0, 1, -1):
+                v, i = ht.sort(x, axis=axis)
+                np.testing.assert_allclose(v.numpy(), np.sort(d, axis=axis), rtol=1e-6)
+                np.testing.assert_array_equal(i.numpy(), np.argsort(d, axis=axis, kind="stable"))
+            v, _ = ht.sort(x, axis=0, descending=True)
+            np.testing.assert_allclose(v.numpy(), -np.sort(-d, axis=0), rtol=1e-6)
+
+    def test_unique(self):
+        v = np.array([3, 1, 2, 1, 3, 5, 2], dtype=np.int32)
+        x = ht.array(v, split=0)
+        got = ht.unique(x, sorted=True)
+        np.testing.assert_array_equal(got.numpy(), np.unique(v))
+        got, inv = ht.unique(x, return_inverse=True)
+        np.testing.assert_array_equal(got.numpy()[inv.numpy()], v)
+
+    def test_topk(self):
+        d = self.d2
+        for split in (None, 0):
+            x = ht.array(d, split=split)
+            v, i = ht.topk(x, 3, dim=1)
+            np.testing.assert_allclose(v.numpy(), -np.sort(-d, axis=1)[:, :3], rtol=1e-6)
+            v, i = ht.topk(x, 2, dim=0, largest=False)
+            np.testing.assert_allclose(v.numpy(), np.sort(d, axis=0)[:2], rtol=1e-6)
+
+    def test_squeeze_expand(self):
+        d = self.d2[:, None, :]
+        for split in (None, 0, 2):
+            x = ht.array(d, split=split)
+            sq = ht.squeeze(x, 1)
+            np.testing.assert_allclose(sq.numpy(), d.squeeze(1))
+            self.assertEqual(sq.split, None if split is None else (0 if split == 0 else 1))
+        x = ht.array(self.d2, split=1)
+        ex = ht.expand_dims(x, 0)
+        self.assertEqual(ex.split, 2)
+        np.testing.assert_allclose(ex.numpy(), self.d2[None])
+
+    def test_pad_roll_flip(self):
+        d = self.d2
+        for split in (None, 0, 1):
+            x = ht.array(d, split=split)
+            self.assert_array_equal(
+                ht.pad(x, [(1, 2), (0, 1)], constant_values=7.0),
+                np.pad(d, [(1, 2), (0, 1)], constant_values=7.0),
+            )
+            self.assert_array_equal(ht.roll(x, 3, axis=0), np.roll(d, 3, 0))
+            self.assert_array_equal(ht.roll(x, (1, -2), axis=(0, 1)), np.roll(d, (1, -2), (0, 1)))
+            self.assert_array_equal(ht.flip(x, 1), np.flip(d, 1))
+            self.assert_array_equal(ht.fliplr(x), np.fliplr(d))
+            self.assert_array_equal(ht.flipud(x), np.flipud(d))
+
+    def test_split_fns(self):
+        d = self.d2
+        x = ht.array(d, split=0)
+        parts = ht.split(x, 2, axis=0)
+        self.assertEqual(len(parts), 2)
+        np.testing.assert_allclose(parts[0].numpy(), d[:3])
+        parts = ht.vsplit(x, [2, 4])
+        np.testing.assert_allclose(parts[1].numpy(), d[2:4])
+        parts = ht.hsplit(x, 4)
+        np.testing.assert_allclose(parts[3].numpy(), d[:, 6:])
+
+    def test_moveaxis_swap_rot(self):
+        d = self.d3
+        for split in (None, 0, 1, 2):
+            x = ht.array(d, split=split)
+            self.assert_array_equal(ht.moveaxis(x, 0, 2), np.moveaxis(d, 0, 2))
+            self.assert_array_equal(ht.swapaxes(x, 0, 1), np.swapaxes(d, 0, 1))
+        x = ht.array(self.d2, split=0)
+        self.assert_array_equal(ht.rot90(x), np.rot90(self.d2))
+
+    def test_diag(self):
+        v = np.arange(5, dtype=np.float32)
+        x = ht.array(v, split=0)
+        self.assert_array_equal(ht.diag(x), np.diag(v))
+        m = ht.array(self.d2, split=0)
+        self.assert_array_equal(ht.diag(m), np.diag(self.d2))
+        self.assert_array_equal(ht.diagonal(m, offset=1), np.diagonal(self.d2, offset=1))
+
+    def test_broadcast_tile_repeat(self):
+        v = np.arange(6, dtype=np.float32)
+        x = ht.array(v, split=0)
+        self.assert_array_equal(ht.broadcast_to(x, (4, 6)), np.broadcast_to(v, (4, 6)))
+        self.assert_array_equal(ht.tile(x, (2, 3)), np.tile(v, (2, 3)))
+        self.assert_array_equal(ht.repeat(x, 3), np.repeat(v, 3))
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

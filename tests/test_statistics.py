@@ -1,0 +1,109 @@
+"""Statistics parity tests vs NumPy oracle across splits (the reference's
+per-module test pattern, core/tests/test_statistics.py)."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+class TestStatistics(TestCase):
+    def setUp(self):
+        np.random.seed(42)
+        self.data = np.random.randn(7, 9).astype(np.float32)
+
+    def test_mean_var_std(self):
+        d = self.data
+        for split in (None, 0, 1):
+            x = ht.array(d, split=split)
+            np.testing.assert_allclose(float(ht.mean(x)), d.mean(), rtol=1e-5)
+            np.testing.assert_allclose(float(ht.var(x)), d.var(), rtol=1e-5)
+            np.testing.assert_allclose(float(ht.std(x)), d.std(), rtol=1e-5)
+            self.assert_array_equal(ht.mean(x, axis=0), d.mean(axis=0))
+            self.assert_array_equal(ht.mean(x, axis=1), d.mean(axis=1))
+            self.assert_array_equal(ht.var(x, axis=0, ddof=1), d.var(axis=0, ddof=1))
+            self.assert_array_equal(ht.std(x, axis=1), d.std(axis=1))
+
+    def test_min_max(self):
+        d = self.data
+        for split in (None, 0, 1):
+            x = ht.array(d, split=split)
+            np.testing.assert_allclose(float(ht.max(x)), d.max())
+            np.testing.assert_allclose(float(ht.min(x)), d.min())
+            self.assert_array_equal(ht.max(x, axis=0), d.max(axis=0))
+            self.assert_array_equal(ht.min(x, axis=1), d.min(axis=1))
+            self.assert_array_equal(ht.maximum(x, -x), np.maximum(d, -d))
+            self.assert_array_equal(ht.minimum(x, -x), np.minimum(d, -d))
+
+    def test_argmax_argmin(self):
+        d = self.data
+        for split in (None, 0, 1):
+            x = ht.array(d, split=split)
+            self.assertEqual(int(ht.argmax(x)), int(d.argmax()))
+            self.assertEqual(int(ht.argmin(x)), int(d.argmin()))
+            self.assert_array_equal(ht.argmax(x, axis=0), d.argmax(axis=0))
+            self.assert_array_equal(ht.argmin(x, axis=1), d.argmin(axis=1))
+
+    def test_average(self):
+        d = self.data
+        w = np.random.rand(9).astype(np.float32)
+        for split in (None, 0):
+            x = ht.array(d, split=split)
+            np.testing.assert_allclose(float(ht.average(x)), np.average(d), rtol=1e-5)
+            got = ht.average(x, axis=1, weights=ht.array(w))
+            np.testing.assert_allclose(got.numpy(), np.average(d, axis=1, weights=w), rtol=1e-5)
+
+    def test_percentile_median(self):
+        d = self.data
+        for split in (None, 0, 1):
+            x = ht.array(d, split=split)
+            np.testing.assert_allclose(
+                float(ht.percentile(x, 30)), np.percentile(d, 30), rtol=1e-5
+            )
+            np.testing.assert_allclose(float(ht.median(x)), np.median(d), rtol=1e-5)
+            got = ht.percentile(x, 75, axis=0)
+            np.testing.assert_allclose(got.numpy(), np.percentile(d, 75, axis=0), rtol=1e-5)
+
+    def test_bincount_digitize(self):
+        v = np.array([0, 1, 1, 2, 2, 2, 5], dtype=np.int32)
+        x = ht.array(v, split=0)
+        self.assert_array_equal(ht.bincount(x), np.bincount(v))
+        self.assert_array_equal(ht.bincount(x, minlength=10), np.bincount(v, minlength=10))
+        bins = np.array([0.0, 1.0, 2.0, 3.0])
+        data = np.array([0.5, 1.5, 2.5, 3.5], dtype=np.float32)
+        hx = ht.array(data, split=0)
+        self.assert_array_equal(ht.digitize(hx, ht.array(bins)), np.digitize(data, bins))
+
+    def test_histogram(self):
+        d = self.data.ravel()
+        x = ht.array(d, split=0)
+        h, e = ht.histogram(x, bins=12)
+        nh, ne = np.histogram(d, bins=12)
+        np.testing.assert_array_equal(h.numpy(), nh)
+        np.testing.assert_allclose(e.numpy(), ne, rtol=1e-6)
+
+    def test_cov(self):
+        d = self.data
+        x = ht.array(d, split=0)
+        np.testing.assert_allclose(ht.cov(x).numpy(), np.cov(d), rtol=1e-4)
+
+    def test_skew_kurtosis(self):
+        from scipy import stats
+
+        d = self.data.ravel()
+        x = ht.array(d, split=0)
+        np.testing.assert_allclose(
+            float(ht.skew(x, unbiased=False)), stats.skew(d, bias=True), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            float(ht.kurtosis(x, unbiased=False, Fischer=True)),
+            stats.kurtosis(d, fisher=True, bias=True),
+            rtol=1e-4,
+        )
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
