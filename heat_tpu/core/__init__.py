@@ -25,9 +25,11 @@ from .sanitation import *
 from .signal import *
 from .statistics import *
 from .stride_tricks import *
+from .tiling import *
 from .trigonometrics import *
 
 from . import random
+from . import tiling
 
 from . import linalg
 from .linalg import *

@@ -1,3 +1,7 @@
 """Distributed linear algebra (reference: /root/reference/heat/core/linalg/)."""
 
 from .basics import *
+from .qr import *
+from .solver import *
+from .svd import *
+from .svdtools import *

@@ -1,0 +1,39 @@
+"""Local LAPACK-style helpers with TPU-toolchain workarounds.
+
+The current TPU compiler SIGABRTs (XLA ``TransposeFolding``:
+``Check failed: buffer != nullptr``) when lowering ``jnp.linalg.svd``
+traced in x64 mode — the int64 index iotas of the QDWH/Jacobi expansion
+trigger the bug; the identical f32 computation traced with x64 disabled
+compiles fine. heat_tpu enables x64 globally for float64/int64 API parity,
+so every SVD callsite goes through ``svd_x32_scope``: a scoped
+``jax.enable_x64(False)`` when the operand is 32-bit (the TPU-relevant
+case). 64-bit operands keep x64 (they run on CPU, whose compiler is fine).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["safe_svd", "safe_svdvals", "svd_x32_scope"]
+
+
+def svd_x32_scope(dtype):
+    """Context manager disabling x64 tracing for 32-bit SVD lowering."""
+    if jnp.dtype(dtype).itemsize <= 4:
+        return jax.enable_x64(False)
+    return contextlib.nullcontext()
+
+
+def safe_svd(a: jax.Array, full_matrices: bool = False):
+    """jnp.linalg.svd with the TPU x64-lowering workaround."""
+    with svd_x32_scope(a.dtype):
+        return jnp.linalg.svd(a, full_matrices=full_matrices)
+
+
+def safe_svdvals(a: jax.Array) -> jax.Array:
+    """Singular values only."""
+    with svd_x32_scope(a.dtype):
+        return jnp.linalg.svd(a, compute_uv=False)

@@ -1,0 +1,99 @@
+"""Full SVD.
+
+The reference ships only a stub raising toward hSVD
+(/root/reference/heat/core/linalg/svd.py:10). Here ``svd`` is implemented:
+replicated arrays use XLA's SVD directly; tall split=0 matrices factor via
+TSQR (one all-gather on ICI) followed by an SVD of the small R —
+``A = QR, R = U_R Σ Vᵀ ⇒ U = Q·U_R`` — wide split=1 matrices via the
+transposed identity. A capability the reference directs users away from.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from typing import Tuple
+
+from .. import types
+from .. import _padding
+from ..dndarray import DNDarray
+from ..sanitation import sanitize_in
+from ._lapack import safe_svd, safe_svdvals
+
+__all__ = ["svd"]
+
+SVD = collections.namedtuple("SVD", "U, S, Vh")
+
+
+def svd(A: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
+    """Singular value decomposition A = U·diag(S)·Vh.
+
+    reduced form only (``full_matrices=False``, the distributed-relevant
+    case; the reference's hSVD equivalents are rank-truncated anyway).
+    """
+    from . import basics
+    from .qr import qr as _qr
+
+    sanitize_in(A)
+    if A.ndim != 2:
+        raise ValueError(f"svd requires a 2-dimensional array, got {A.ndim}")
+    if full_matrices:
+        raise NotImplementedError("only the reduced SVD (full_matrices=False) is provided")
+
+    dtype = A.dtype
+    if types.heat_type_is_exact(dtype):
+        dtype = types.float32
+    jt = dtype.jax_type()
+    m, n = A.shape
+    comm = A.comm
+
+    if A.split == 0 and comm.is_distributed() and m >= n:
+        q, r = _qr(A if A.dtype == dtype else A.astype(dtype), calc_q=compute_uv)
+        if not compute_uv:
+            s = safe_svdvals(r.larray)
+            return DNDarray(s, (int(s.shape[0]),), dtype, None, A.device, comm)
+        u_r, s, vh = safe_svd(r.larray, full_matrices=False)
+        u_phys = _padding.mask_phys(q._phys @ u_r, (m, int(u_r.shape[1])), 0)
+        U = DNDarray(u_phys, (m, int(u_r.shape[1])), dtype, 0, A.device, comm)
+        S = DNDarray(s, (int(s.shape[0]),), dtype, None, A.device, comm)
+        Vh = DNDarray(vh, tuple(int(x) for x in vh.shape), dtype, None, A.device, comm)
+        return SVD(U, S, Vh)
+
+    if A.split == 1 and comm.is_distributed() and n > m:
+        # wide: svd(Aᵀ) and swap factors
+        res = svd(basics.transpose(A, None), full_matrices=False, compute_uv=compute_uv)
+        if not compute_uv:
+            return res
+        U_t, S, Vh_t = res
+        return SVD(basics.transpose(Vh_t, None), S, basics.transpose(U_t, None))
+
+    arr = A.larray.astype(jt)
+    if not compute_uv:
+        s = safe_svdvals(arr)
+        return DNDarray(s, (int(s.shape[0]),), dtype, None, A.device, comm)
+    u, s, vh = safe_svd(arr, full_matrices=False)
+    split_u = A.split if A.split == 0 else None
+    split_vh = 1 if A.split == 1 else None
+    U = DNDarray(
+        comm.shard(u, split_u) if split_u is not None else u,
+        tuple(int(x) for x in u.shape),
+        dtype,
+        split_u,
+        A.device,
+        comm,
+    )
+    S = DNDarray(s, (int(s.shape[0]),), dtype, None, A.device, comm)
+    Vh = DNDarray(
+        comm.shard(vh, split_vh) if split_vh is not None else vh,
+        tuple(int(x) for x in vh.shape),
+        dtype,
+        split_vh,
+        A.device,
+        comm,
+    )
+    return SVD(U, S, Vh)

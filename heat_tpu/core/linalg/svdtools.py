@@ -1,0 +1,343 @@
+"""Hierarchical SVD — the north-star operation.
+
+API parity with /root/reference/heat/core/linalg/svdtools.py (``hsvd_rank``
+:31, ``hsvd_rtol`` :124, ``hsvd`` :259, ``compute_local_truncated_svd``
+:477; algorithm after Iwen/Ong 2016 and Himpe/Leibner/Rave 2018). The
+reference runs: transpose if split=0 (:314-318) → per-rank truncated local
+SVD → a greedy Send/Recv **merge tree** over shrinking rank sets
+(:346-445) → Bcast of the final U.
+
+TPU-native redesign (same math, different schedule):
+
+1. **Level 0** — one ``shard_map``: every device computes the truncated
+   SVD of its local column block and scales ``U_loc·Σ_loc``; discarded
+   energy is accumulated for the a-posteriori error bound. Output is the
+   global matrix ``B = [U_1Σ_1 ∥ … ∥ U_pΣ_p]`` (m × p·r), sharded along
+   columns — no host round-trip.
+2. **Merge** — instead of a log-depth Send/Recv tree whose node count
+   shrinks dynamically (hostile to XLA's static shapes), the merge is ONE
+   TSQR of ``B`` (see ``qr.py``) followed by an SVD of the tiny
+   (p·r × p·r) R factor: ``B = Q·R``, ``R = U_R Σ V^T`` ⇒ left singular
+   vectors ``Q·U_R`` — one all-gather of R factors on ICI plus local MXU
+   matmuls. Mathematically this *is* a single-level merge with exact
+   arithmetic on the concatenated factors; the truncation error analysis
+   of the reference applies unchanged.
+3. rank-budget (``hsvd_rank``) truncates statically; tolerance mode
+   (``hsvd_rtol``) picks the final rank from the merged spectrum on host
+   (a scalar-sized transfer), keeping all array shapes static under jit.
+
+``maxmergedim``/``no_of_merges`` tuned the reference's tree arity against
+MPI message sizes; the TSQR merge has no such knob — they are accepted and
+validated for API parity.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import PartitionSpec
+from typing import Optional, Tuple, Union
+
+from .. import types
+from .. import _padding
+from ..communication import MeshCommunication
+from ..dndarray import DNDarray
+from ..sanitation import sanitize_in
+from ._lapack import safe_svd, svd_x32_scope
+
+__all__ = ["hsvd", "hsvd_rank", "hsvd_rtol"]
+
+
+@functools.lru_cache(maxsize=128)
+def _local_svd_fn(mesh, axis_name: str, lrows: int, lcols: int, rloc: int, jdtype: str):
+    """Compiled level-0 kernel: per-shard truncated SVD → U·Σ block plus
+    discarded-energy scalar (the analog of reference
+    ``compute_local_truncated_svd``, svdtools.py:477)."""
+
+    def kernel(a_blk):
+        # a_blk: (lrows, lcols) local column block of A (split=1 layout)
+        u, s, _ = jnp.linalg.svd(a_blk, full_matrices=False)
+        k = s.shape[0]
+        keep = min(rloc, k)
+        u_scaled = u[:, :keep] * s[:keep]
+        if keep < rloc:
+            u_scaled = jnp.pad(u_scaled, ((0, 0), (0, rloc - keep)))
+        err_sq = jnp.sum(s[keep:] ** 2)
+        return u_scaled, err_sq[None]  # singleton axis so shards concatenate
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=PartitionSpec(None, axis_name),
+            out_specs=(PartitionSpec(None, axis_name), PartitionSpec(axis_name)),
+            check_vma=False,
+        )
+    )
+
+
+def _merge_svd(B: DNDarray, calc_u: bool = True):
+    """SVD of the stacked factor matrix via TSQR + small-R SVD.
+
+    B (m × K) with K = p·r small: resplit to rows, TSQR, then SVD of the
+    K×K R on-device (replicated — it is tiny).
+    Returns (U as DNDarray split=0 | None, s, total extra err 0.0).
+    """
+    from .qr import qr as _qr
+
+    m, K = B.shape
+    if m >= K:
+        Brow = B.resplit(0)
+        q, r = _qr(Brow, calc_q=calc_u)
+        u_r, s, _ = safe_svd(r.larray, full_matrices=False)
+        if not calc_u:
+            return None, s
+        U = DNDarray(
+            _padding.mask_phys(q._phys @ u_r, (m, int(u_r.shape[1])), 0),
+            (m, int(u_r.shape[1])),
+            q.dtype,
+            0,
+            B.device,
+            B.comm,
+        )
+        return U, s
+    # short-fat stacked matrix: gather (it is small by construction)
+    u, s, _ = safe_svd(B.larray, full_matrices=False)
+    U = DNDarray(
+        B.comm.shard(u, 0), (int(u.shape[0]), int(u.shape[1])), B.dtype, 0, B.device, B.comm
+    )
+    return U, s
+
+
+def hsvd_rank(
+    A: DNDarray,
+    maxrank: int,
+    compute_sv: bool = False,
+    maxmergedim: Optional[int] = None,
+    safetyshift: int = 5,
+    silent: bool = True,
+):
+    """Truncated hierarchical SVD with a fixed rank budget (reference:
+    svdtools.py:31). Returns ``(U, sigma, V, rel_error_estimate)`` when
+    ``compute_sv=True`` else ``(U, rel_error_estimate)``.
+    """
+    sanitize_in(A)
+    if A.ndim != 2:
+        raise ValueError(f"hsvd requires a 2-dimensional array, got {A.ndim}")
+    if not isinstance(maxrank, (int, np.integer)) or maxrank < 1:
+        raise ValueError(f"maxrank must be a positive integer, got {maxrank}")
+    if maxmergedim is not None and maxmergedim < 2 * (maxrank + safetyshift) + 1:
+        raise ValueError(
+            "maxmergedim too small for maxrank+safetyshift (reference constraint, svdtools.py)"
+        )
+    return _hsvd_impl(
+        A,
+        maxrank=int(maxrank),
+        rtol=None,
+        safetyshift=int(safetyshift),
+        compute_sv=compute_sv,
+        silent=silent,
+    )
+
+
+def hsvd_rtol(
+    A: DNDarray,
+    rtol: float,
+    compute_sv: bool = False,
+    maxrank: Optional[int] = None,
+    maxmergedim: Optional[int] = None,
+    no_of_merges: Optional[int] = None,
+    silent: bool = True,
+    safetyshift: int = 5,
+):
+    """Hierarchical SVD truncated to a relative error tolerance (reference:
+    svdtools.py:124): the returned factorization satisfies
+    ‖A − UΣVᵀ‖_F ≤ rtol·‖A‖_F (upper-bound estimate).
+    """
+    sanitize_in(A)
+    if A.ndim != 2:
+        raise ValueError(f"hsvd requires a 2-dimensional array, got {A.ndim}")
+    if rtol <= 0:
+        raise ValueError(f"rtol must be positive, got {rtol}")
+    return _hsvd_impl(
+        A,
+        maxrank=int(maxrank) if maxrank is not None else None,
+        rtol=float(rtol),
+        safetyshift=int(safetyshift),
+        compute_sv=compute_sv,
+        silent=silent,
+    )
+
+
+def hsvd(
+    A: DNDarray,
+    maxrank: Optional[int] = None,
+    maxmergedim: Optional[int] = None,
+    rtol: Optional[float] = None,
+    safetyshift: int = 0,
+    no_of_merges: Optional[int] = 2,
+    compute_sv: bool = False,
+    silent: bool = True,
+    warnings_off: bool = False,
+):
+    """General hierarchical SVD entry point (reference: svdtools.py:259)."""
+    sanitize_in(A)
+    if maxrank is None and rtol is None:
+        raise ValueError("at least one of maxrank and rtol must be given")
+    return _hsvd_impl(
+        A,
+        maxrank=int(maxrank) if maxrank is not None else None,
+        rtol=rtol,
+        safetyshift=int(safetyshift),
+        compute_sv=compute_sv,
+        silent=silent,
+    )
+
+
+def _hsvd_impl(
+    A: DNDarray,
+    maxrank: Optional[int],
+    rtol: Optional[float],
+    safetyshift: int,
+    compute_sv: bool,
+    silent: bool,
+):
+    from . import basics
+
+    comm: MeshCommunication = A.comm
+    dtype = A.dtype
+    if types.heat_type_is_exact(dtype):
+        dtype = types.float32
+    jt = dtype.jax_type()
+
+    # orient split=1 (columns distributed) — reference svdtools.py:314-318
+    transposed = False
+    work = A
+    if A.split == 0:
+        work = basics.transpose(A, None)
+        transposed = True
+
+    m, n = work.shape
+    full_rank_cap = min(m, n)
+
+    # Frobenius norm for the relative error estimate
+    a_norm = float(jnp.linalg.norm(work.larray.astype(jt)))
+
+    if work.split is None or not comm.is_distributed():
+        # single-device path: plain truncated SVD
+        u, s, vt = safe_svd(work.larray.astype(jt), full_matrices=False)
+        err_sq = 0.0
+        r_final = _choose_rank(np.asarray(s), maxrank, rtol, a_norm, err_sq, full_rank_cap)
+        U_arr = DNDarray(u[:, :r_final], (m, r_final), dtype, None, A.device, comm)
+        s_np = s[:r_final]
+        err = float(np.sqrt(np.sum(np.asarray(s[r_final:]) ** 2))) / max(a_norm, 1e-30)
+    else:
+        p = comm.size
+        rloc = min(m, -(-n // p))
+        if maxrank is not None:
+            rloc = min(rloc, maxrank + safetyshift)
+        phys = work._phys.astype(jt)
+        lcols = phys.shape[1] // p
+        fn = _local_svd_fn(comm.mesh, comm.axis_name, phys.shape[0], lcols, rloc, np.dtype(jt).name)
+        with svd_x32_scope(jt):
+            b_phys, err_blocks = fn(phys)
+        level_err_sq = float(jnp.sum(err_blocks))
+        B = DNDarray(
+            b_phys, (m, int(b_phys.shape[1])), dtype, 1, A.device, comm
+        )
+        U_merged, s_all = _merge_svd(B, calc_u=True)
+        s_np_all = np.asarray(s_all)
+        r_final = _choose_rank(s_np_all, maxrank, rtol, a_norm, level_err_sq, full_rank_cap)
+        merge_err_sq = float(np.sum(s_np_all[r_final:] ** 2))
+        err = float(np.sqrt(level_err_sq + merge_err_sq)) / max(a_norm, 1e-30)
+        # truncate U to the final rank
+        u_trunc = U_merged.larray[:, :r_final]
+        U_arr = DNDarray(comm.shard(u_trunc, 0), (m, r_final), dtype, 0, A.device, comm)
+        s_np = s_all[:r_final]
+
+    sigma = DNDarray(
+        jax.device_put(jnp.asarray(s_np), comm.sharding(1, None)),
+        (int(np.asarray(s_np).shape[0]),),
+        dtype,
+        None,
+        A.device,
+        comm,
+    )
+
+    if transposed:
+        # A = U Σ Vᵀ for the original orientation: swap factors
+        U_of_A = None
+        V_of_A = U_arr
+    else:
+        U_of_A = U_arr
+        V_of_A = None
+
+    if not compute_sv:
+        # reference returns (U, relerr) where U are the left singular
+        # vectors of the *input orientation*
+        primary = U_of_A if U_of_A is not None else _postprocess_v(A, V_of_A, sigma, left=True)
+        return primary, err
+
+    # compute the missing factor via the reference's postprocessing
+    # (svdtools.py:456-467): V = Aᵀ U Σ⁻¹ (or U = A V Σ⁻¹)
+    if U_of_A is not None:
+        V = _postprocess_v(A, U_of_A, sigma, left=False)
+        return U_of_A, sigma, V, err
+    U = _postprocess_v(A, V_of_A, sigma, left=True)
+    return U, sigma, V_of_A, err
+
+
+def _postprocess_v(A: DNDarray, factor: DNDarray, sigma: DNDarray, left: bool) -> DNDarray:
+    """Compute the complementary singular factor: V = Aᵀ U / σ or
+    U = A V / σ (reference: svdtools.py:456-467)."""
+    from . import basics
+
+    if left:
+        prod = basics.matmul(A, factor)  # (m, r)
+    else:
+        prod = basics.matmul(basics.transpose(A, None), factor)  # (n, r)
+    inv_sigma = jnp.where(sigma.larray > 0, 1.0 / sigma.larray, 0.0)
+    scaled = prod.larray * inv_sigma
+    return DNDarray(
+        prod.comm.shard(scaled, prod.split) if prod.split is not None else scaled,
+        prod.shape,
+        prod.dtype,
+        prod.split,
+        prod.device,
+        prod.comm,
+    )
+
+
+def _choose_rank(
+    s: np.ndarray,
+    maxrank: Optional[int],
+    rtol: Optional[float],
+    a_norm: float,
+    prior_err_sq: float,
+    cap: int,
+) -> int:
+    """Final truncation rank: static budget and/or smallest rank whose
+    discarded energy keeps the total error below rtol·‖A‖ (reference
+    truncation logic in compute_local_truncated_svd / hsvd)."""
+    s = np.asarray(s, dtype=np.float64)
+    k = min(len(s), cap)
+    if rtol is None:
+        return max(1, min(maxrank, k))
+    budget_sq = (rtol * a_norm) ** 2 - prior_err_sq
+    # discarded tail energy for every candidate rank
+    tail = np.cumsum((s[::-1] ** 2))[::-1]  # tail[i] = sum_{j>=i} s_j^2
+    r = k
+    for i in range(k, 0, -1):
+        discard = tail[i] if i < len(s) else 0.0
+        if discard <= max(budget_sq, 0.0):
+            r = i
+        else:
+            break
+    if maxrank is not None:
+        r = min(r, maxrank)
+    return max(1, r)

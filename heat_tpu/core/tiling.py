@@ -1,0 +1,175 @@
+"""Tile decompositions.
+
+API parity with /root/reference/heat/core/tiling.py (``SplitTiles`` :16 —
+per-rank theoretical chunk grid consumed by ``resplit_``;
+``SquareDiagTiles`` :331 — square diagonal tiles with ``tiles_per_proc``
+consumed by the tiled QR). In this framework resharding and QR are
+expressed declaratively (GSPMD + TSQR), so the tile maps are not load-
+bearing — they are provided as geometry objects for API parity and for
+algorithms users may have built on them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from typing import List, Optional, Tuple, Union
+
+from .dndarray import DNDarray
+
+__all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+class SplitTiles:
+    """Tiles along every dimension with the split-axis boundaries of every
+    device (reference: tiling.py:16). ``tile_dimensions[d]`` holds the tile
+    extents along dim d; one tile boundary set per device along each dim.
+    """
+
+    def __init__(self, arr: DNDarray):
+        if not isinstance(arr, DNDarray):
+            raise TypeError(f"arr must be a DNDarray, got {type(arr)}")
+        self.__arr = arr
+        size = arr.comm.size
+        # per-dim tile extents: the split dim follows the chunk geometry,
+        # other dims are chunked the same way "theoretically" (reference
+        # computes torch chunk sizes per dim)
+        dims = []
+        for d in range(arr.ndim):
+            counts = [
+                arr.comm.chunk(arr.gshape, d, rank=r)[1][d] for r in range(size)
+            ]
+            dims.append(np.array(counts, dtype=np.int64))
+        self.__tile_dimensions = dims
+        self.__tile_locations = self.set_tile_locations(
+            split=arr.split, tile_dims=dims, arr=arr
+        )
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def tile_dimensions(self) -> List[np.ndarray]:
+        return self.__tile_dimensions
+
+    @property
+    def tile_locations(self) -> np.ndarray:
+        return self.__tile_locations
+
+    @staticmethod
+    def set_tile_locations(split: Optional[int], tile_dims: List[np.ndarray], arr: DNDarray) -> np.ndarray:
+        """Device owning each tile (reference: tiling.py set_tile_locations)."""
+        shape = tuple(len(t) for t in tile_dims)
+        locations = np.zeros(shape, dtype=np.int64)
+        if split is None:
+            return locations
+        size = arr.comm.size
+        idx = [slice(None)] * len(shape)
+        for r in range(size):
+            idx[split] = r
+            locations[tuple(idx)] = r
+        return locations
+
+    def __getitem__(self, key) -> Optional[np.ndarray]:
+        """Tile data as numpy for the requested tile index (geometry demo;
+        the reference returns the local torch slice)."""
+        starts = [np.concatenate([[0], np.cumsum(t)]) for t in self.__tile_dimensions]
+        if not isinstance(key, tuple):
+            key = (key,)
+        slices = []
+        for d in range(self.__arr.ndim):
+            if d < len(key):
+                k = key[d]
+                slices.append(slice(int(starts[d][k]), int(starts[d][k + 1])))
+            else:
+                slices.append(slice(None))
+        # slice on device first: only the tile travels to host
+        return np.asarray(self.__arr.larray[tuple(slices)])
+
+
+class SquareDiagTiles:
+    """Square tiles along the diagonal of a 2-D array (reference:
+    tiling.py:331): used by the reference's tiled QR; provided here as a
+    geometry object (``tiles_per_proc`` partitions each device's band).
+    """
+
+    def __init__(self, arr: DNDarray, tiles_per_proc: int = 2):
+        if not isinstance(arr, DNDarray):
+            raise TypeError(f"arr must be a DNDarray, got {type(arr)}")
+        if arr.ndim != 2:
+            raise ValueError("Arr must be 2 dimensional")
+        if not isinstance(tiles_per_proc, int) or tiles_per_proc < 1:
+            raise ValueError(f"tiles_per_proc must be a positive int, got {tiles_per_proc}")
+        self.__arr = arr
+        size = arr.comm.size
+        m, n = arr.gshape
+        split = arr.split if arr.split is not None else 0
+
+        # per-device extents along the split dim
+        counts = [arr.comm.chunk(arr.gshape, split, rank=r)[1][split] for r in range(size)]
+        row_per_proc = []
+        row_starts = [0]
+        for c in counts:
+            per = max(1, tiles_per_proc)
+            base = c // per
+            rem = c % per
+            sizes = [base + (1 if i < rem else 0) for i in range(per)]
+            sizes = [s for s in sizes if s > 0]
+            row_per_proc.append(len(sizes))
+            for s in sizes:
+                row_starts.append(row_starts[-1] + s)
+        # square tiles: column boundaries mirror row boundaries up to n
+        col_bounds = [b for b in row_starts if b <= n]
+        if col_bounds[-1] != n:
+            col_bounds.append(n)
+
+        self.__row_starts = np.array(row_starts, dtype=np.int64)
+        self.__col_starts = np.array(col_bounds, dtype=np.int64)
+        self.__tile_rows_per_process = row_per_proc
+        self.__tile_columns = len(self.__col_starts) - 1
+        self.__tile_rows = len(self.__row_starts) - 1
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def tile_columns(self) -> int:
+        """Number of tile columns (reference: tiling.py tile_columns)."""
+        return self.__tile_columns
+
+    @property
+    def tile_rows(self) -> int:
+        """Number of tile rows."""
+        return self.__tile_rows
+
+    @property
+    def tile_rows_per_process(self) -> List[int]:
+        return list(self.__tile_rows_per_process)
+
+    @property
+    def row_indices(self) -> List[int]:
+        return self.__row_starts[:-1].tolist()
+
+    @property
+    def col_indices(self) -> List[int]:
+        return self.__col_starts[:-1].tolist()
+
+    def get_tile_size(self, key: Tuple[int, int]) -> Tuple[int, int]:
+        """(rows, cols) of tile ``key``."""
+        i, j = key
+        return (
+            int(self.__row_starts[i + 1] - self.__row_starts[i]),
+            int(self.__col_starts[j + 1] - self.__col_starts[j]),
+        )
+
+    def __getitem__(self, key) -> np.ndarray:
+        if not isinstance(key, tuple):
+            key = (key, slice(None))
+        i, j = key
+        rs, re = int(self.__row_starts[i]), int(self.__row_starts[i + 1])
+        if isinstance(j, slice):
+            return np.asarray(self.__arr.larray[rs:re])
+        cs, ce = int(self.__col_starts[j]), int(self.__col_starts[j + 1])
+        return np.asarray(self.__arr.larray[rs:re, cs:ce])

@@ -1,0 +1,232 @@
+"""Linear algebra tests: matmul split rules, TSQR, hSVD, CG, Lanczos, SVD
+(reference pattern: core/linalg/tests/ iterate split × shape)."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+class TestMatmul(TestCase):
+    def test_matmul_all_splits(self):
+        np.random.seed(1)
+        a = np.random.randn(16, 12).astype(np.float32)
+        b = np.random.randn(12, 10).astype(np.float32)
+        expected = a @ b
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                ha = ht.array(a, split=sa)
+                hb = ht.array(b, split=sb)
+                c = ht.matmul(ha, hb)
+                np.testing.assert_allclose(c.numpy(), expected, rtol=1e-4)
+        # reference split rules (basics.py:421-436)
+        self.assertEqual(ht.matmul(ht.array(a, split=0), ht.array(b)).split, 0)
+        self.assertEqual(ht.matmul(ht.array(a), ht.array(b, split=1)).split, 1)
+
+    def test_matmul_uneven(self):
+        a = np.random.randn(13, 7).astype(np.float32)
+        b = np.random.randn(7, 5).astype(np.float32)
+        c = ht.matmul(ht.array(a, split=0), ht.array(b, split=0))
+        np.testing.assert_allclose(c.numpy(), a @ b, rtol=1e-4)
+
+    def test_dot_outer(self):
+        x = np.random.randn(20).astype(np.float32)
+        y = np.random.randn(20).astype(np.float32)
+        hx, hy = ht.array(x, split=0), ht.array(y, split=0)
+        np.testing.assert_allclose(float(ht.dot(hx, hy)), x @ y, rtol=1e-4)
+        np.testing.assert_allclose(ht.outer(hx, hy).numpy(), np.outer(x, y), rtol=1e-4)
+
+    def test_inv_det_trace(self):
+        m = np.random.randn(6, 6).astype(np.float64)
+        m = m @ m.T + 6 * np.eye(6)
+        for split in (None, 0, 1):
+            hm = ht.array(m, split=split)
+            np.testing.assert_allclose(ht.inv(hm).numpy(), np.linalg.inv(m), rtol=1e-6)
+            np.testing.assert_allclose(float(ht.det(hm)), np.linalg.det(m), rtol=1e-6)
+            np.testing.assert_allclose(float(ht.trace(hm)), np.trace(m), rtol=1e-6)
+
+    def test_norms(self):
+        a = np.random.randn(8, 6).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose(float(ht.norm(x)), np.linalg.norm(a), rtol=1e-5)
+            np.testing.assert_allclose(
+                ht.vector_norm(x, axis=0).numpy(), np.linalg.norm(a, axis=0), rtol=1e-5
+            )
+            np.testing.assert_allclose(
+                float(ht.matrix_norm(x, ord="fro")), np.linalg.norm(a, "fro"), rtol=1e-5
+            )
+
+    def test_transpose_tri(self):
+        a = np.random.randn(5, 7).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            t = x.T
+            np.testing.assert_allclose(t.numpy(), a.T)
+            self.assertEqual(t.split, None if split is None else 1 - split)
+            np.testing.assert_allclose(ht.tril(x).numpy(), np.tril(a))
+            np.testing.assert_allclose(ht.triu(x, 1).numpy(), np.triu(a, 1))
+
+
+class TestQR(TestCase):
+    def _check_qr(self, a_np, split):
+        x = ht.array(a_np, split=split)
+        q, r = ht.linalg.qr(x)
+        m, n = a_np.shape
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a_np, atol=1e-4)
+        np.testing.assert_allclose(
+            q.numpy().T @ q.numpy(), np.eye(q.shape[1]), atol=1e-4
+        )
+        np.testing.assert_allclose(np.tril(r.numpy(), -1), 0, atol=1e-5)
+
+    def test_qr_tall_split0(self):
+        np.random.seed(2)
+        self._check_qr(np.random.randn(64, 8).astype(np.float32), 0)
+        self._check_qr(np.random.randn(50, 7).astype(np.float32), 0)  # uneven
+        self._check_qr(np.random.randn(9, 3).astype(np.float32), 0)  # m < mesh·n
+
+    def test_qr_split1_and_none(self):
+        a = np.random.randn(20, 12).astype(np.float32)
+        self._check_qr(a, 1)
+        self._check_qr(a, None)
+
+    def test_qr_no_q(self):
+        a = np.random.randn(40, 6).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(a, split=0), calc_q=False)
+        self.assertIsNone(q)
+        np.testing.assert_allclose(
+            np.abs(r.numpy()), np.abs(np.linalg.qr(a, mode="r")), atol=1e-4
+        )
+
+
+class TestHSVD(TestCase):
+    def _low_rank(self, m, n, rank):
+        np.random.seed(3)
+        u = np.linalg.qr(np.random.randn(m, rank))[0]
+        v = np.linalg.qr(np.random.randn(n, rank))[0]
+        s = np.linspace(10, 1, rank)
+        return (u * s) @ v.T
+
+    def test_hsvd_rank_split1(self):
+        a = self._low_rank(40, 64, 5).astype(np.float32)
+        x = ht.array(a, split=1)
+        u, s, v, err = ht.linalg.hsvd_rank(x, 5, compute_sv=True)
+        self.assertLessEqual(err, 1e-4)
+        np.testing.assert_allclose(
+            u.numpy() * s.numpy() @ v.numpy().T, a, atol=1e-3
+        )
+        # U orthonormal
+        np.testing.assert_allclose(u.numpy().T @ u.numpy(), np.eye(5), atol=1e-4)
+        np.testing.assert_allclose(
+            s.numpy(), np.linalg.svd(a, compute_uv=False)[:5], rtol=1e-3
+        )
+
+    def test_hsvd_rank_split0(self):
+        a = self._low_rank(64, 40, 4).astype(np.float32)
+        x = ht.array(a, split=0)
+        u, s, v, err = ht.linalg.hsvd_rank(x, 4, compute_sv=True)
+        np.testing.assert_allclose(u.numpy() * s.numpy() @ v.numpy().T, a, atol=1e-3)
+
+    def test_hsvd_rank_truncation_error(self):
+        # full-rank matrix truncated to rank 3: error ≈ tail energy
+        np.random.seed(4)
+        a = np.random.randn(32, 24).astype(np.float32)
+        x = ht.array(a, split=1)
+        u, err = ht.linalg.hsvd_rank(x, 3)
+        s_true = np.linalg.svd(a, compute_uv=False)
+        expected_rel = np.sqrt(np.sum(s_true[3:] ** 2)) / np.linalg.norm(a)
+        self.assertEqual(u.shape, (32, 3))
+        # upper bound should hold and not be wildly pessimistic
+        self.assertGreaterEqual(err * 1.05, expected_rel)
+        self.assertLess(err, 5 * expected_rel + 0.1)
+
+    def test_hsvd_rtol(self):
+        a = self._low_rank(48, 32, 6).astype(np.float32)
+        a = a + 1e-3 * np.random.randn(48, 32).astype(np.float32)
+        x = ht.array(a, split=1)
+        u, s, v, err = ht.linalg.hsvd_rtol(x, 0.05, compute_sv=True)
+        self.assertLessEqual(err, 0.05 + 1e-6)
+        recon = u.numpy() * s.numpy() @ v.numpy().T
+        self.assertLessEqual(
+            np.linalg.norm(recon - a) / np.linalg.norm(a), 0.05 + 1e-3
+        )
+
+    def test_hsvd_errors(self):
+        x = ht.ones((4, 4), split=0)
+        with self.assertRaises(ValueError):
+            ht.linalg.hsvd_rank(x, 0)
+        with self.assertRaises(ValueError):
+            ht.linalg.hsvd_rtol(x, -1.0)
+        with self.assertRaises(ValueError):
+            ht.linalg.hsvd(x)
+
+
+class TestSVD(TestCase):
+    def test_svd_tall_split0(self):
+        np.random.seed(5)
+        a = np.random.randn(64, 10).astype(np.float32)
+        u, s, vh = ht.linalg.svd(ht.array(a, split=0))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ vh.numpy(), a, atol=1e-3)
+        np.testing.assert_allclose(
+            s.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-4
+        )
+        np.testing.assert_allclose(u.numpy().T @ u.numpy(), np.eye(10), atol=1e-4)
+
+    def test_svd_wide_split1(self):
+        a = np.random.randn(10, 64).astype(np.float32)
+        u, s, vh = ht.linalg.svd(ht.array(a, split=1))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ vh.numpy(), a, atol=1e-3)
+
+    def test_svd_values_only(self):
+        a = np.random.randn(30, 8).astype(np.float32)
+        s = ht.linalg.svd(ht.array(a, split=0), compute_uv=False)
+        np.testing.assert_allclose(s.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-4)
+
+
+class TestSolver(TestCase):
+    def test_cg(self):
+        np.random.seed(6)
+        n = 16
+        a = np.random.randn(n, n).astype(np.float32)
+        a = a @ a.T + n * np.eye(n, dtype=np.float32)
+        b = np.random.randn(n).astype(np.float32)
+        x_expected = np.linalg.solve(a, b)
+        for split in (None, 0):
+            A = ht.array(a, split=split)
+            B = ht.array(b)
+            x0 = ht.zeros(n)
+            x = ht.linalg.cg(A, B, x0)
+            np.testing.assert_allclose(x.numpy(), x_expected, atol=1e-3)
+
+    def test_lanczos(self):
+        np.random.seed(7)
+        n = 12
+        a = np.random.randn(n, n).astype(np.float64)
+        a = (a + a.T) / 2
+        A = ht.array(a, split=0, dtype=ht.float64)
+        V, T = ht.linalg.lanczos(A, n)
+        # V T V^T ≈ A for full Krylov space
+        v, t = V.numpy(), T.numpy()
+        np.testing.assert_allclose(v @ t @ v.T, a, atol=1e-6)
+
+
+class TestTiling(TestCase):
+    def test_split_tiles(self):
+        x = ht.arange(64, split=0).reshape(8, 8)
+        tiles = ht.tiling.SplitTiles(x)
+        self.assertEqual(len(tiles.tile_dimensions), 2)
+        self.assertEqual(int(np.sum(tiles.tile_dimensions[0])), 8)
+
+    def test_square_diag_tiles(self):
+        x = ht.zeros((16, 16), split=0)
+        tiles = ht.tiling.SquareDiagTiles(x, tiles_per_proc=2)
+        self.assertGreaterEqual(tiles.tile_rows, 8)
+        rows, cols = tiles.get_tile_size((0, 0))
+        self.assertGreater(rows, 0)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
