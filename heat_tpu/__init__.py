@@ -24,4 +24,12 @@ from .core import *
 from .core.linalg import *
 
 from . import core
+from . import classification
+from . import cluster
+from . import graph
+from . import naive_bayes
+from . import preprocessing
+from . import regression
+from . import spatial
+from . import utils
 from .version import __version__

@@ -1,0 +1,3 @@
+"""Distributed classification (reference: /root/reference/heat/classification/)."""
+
+from .kneighborsclassifier import *

@@ -1,0 +1,82 @@
+"""K-nearest-neighbors classifier.
+
+API parity with /root/reference/heat/classification/kneighborsclassifier.py
+(``KNeighborsClassifier`` :18: fit stores the data; predict = cdist + topk
++ one-hot vote, :45-131). The vote here is one fused expression on the
+sharded distance matrix.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from typing import Callable, Optional
+
+from ..core import types
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+from ..spatial import distance
+
+__all__ = ["KNeighborsClassifier"]
+
+
+class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
+    """Classification by majority vote of the k nearest neighbors
+    (reference: kneighborsclassifier.py:18)."""
+
+    def __init__(self, n_neighbors: int = 5, effective_metric_: Optional[Callable] = None):
+        self.n_neighbors = n_neighbors
+        self.effective_metric_ = effective_metric_ if effective_metric_ is not None else distance.cdist
+        self.x = None
+        self.y = None
+        self._classes = None
+
+    def fit(self, x: DNDarray, y: DNDarray) -> "KNeighborsClassifier":
+        """Store training data and labels (reference:
+        kneighborsclassifier.py fit). ``y`` may be 1-D labels or one-hot."""
+        sanitize_in(x)
+        sanitize_in(y)
+        if y.ndim == 1:
+            classes = jnp.unique(y.larray)
+            self._classes = classes
+            onehot = (y.larray[:, None] == classes[None, :]).astype(jnp.float32)
+            self.y = DNDarray(
+                x.comm.shard(onehot, y.split) if y.split is not None else onehot,
+                tuple(int(s) for s in onehot.shape),
+                types.float32,
+                y.split,
+                y.device,
+                y.comm,
+            )
+        elif y.ndim == 2:
+            self._classes = jnp.arange(y.shape[1])
+            self.y = y
+        else:
+            raise ValueError(f"labels must be 1- or 2-dimensional, got {y.ndim}")
+        self.x = x
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Majority vote over the k nearest training points (reference:
+        kneighborsclassifier.py predict)."""
+        sanitize_in(x)
+        if self.x is None:
+            raise RuntimeError("fit needs to be called before predict")
+        dist = self.effective_metric_(x, self.x)
+        neg = -dist.larray
+        _, idx = jax.lax.top_k(neg, self.n_neighbors)  # (n_query, k)
+        votes = jnp.take(self.y.larray, idx, axis=0)  # (n_query, k, n_classes)
+        counts = jnp.sum(votes, axis=1)
+        winners = jnp.argmax(counts, axis=1)
+        labels = jnp.take(self._classes, winners)
+        gshape = (x.shape[0],)
+        split = 0 if x.split is not None else None
+        if split is not None:
+            labels = x.comm.shard(labels, split)
+        return DNDarray(
+            labels, gshape, types.canonical_heat_type(labels.dtype), split, x.device, x.comm
+        )

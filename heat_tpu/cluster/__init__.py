@@ -1,0 +1,6 @@
+"""Distributed clustering (reference: /root/reference/heat/cluster/)."""
+
+from .kmeans import *
+from .kmedians import *
+from .kmedoids import *
+from .spectral import *

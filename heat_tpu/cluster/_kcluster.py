@@ -1,0 +1,189 @@
+"""Shared k-clustering machinery.
+
+API parity with /root/reference/heat/cluster/_kcluster.py (``_KCluster``:
+init strategies ``random``/``probability_based`` (k-means++) with
+per-centroid Bcast from the owning rank at _kcluster.py:100-187; assignment
+= cdist + argmin at :196-209). Here initialization samples/percolates on
+the sharded global array (no rank-owned rows — the controller indexes the
+global array and XLA fetches the row), and each Lloyd-style iteration is a
+single jit: distances on the MXU via the quadratic expansion, masked
+per-cluster reductions lowering to one all-reduce over the mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from typing import Callable, Optional, Union
+
+from ..core import factories, random as ht_random, types
+from ..core.base import BaseEstimator, ClusteringMixin
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+
+__all__ = ["_KCluster"]
+
+
+class _KCluster(BaseEstimator, ClusteringMixin):
+    """Base class for k-statistics clustering (reference: _kcluster.py)."""
+
+    def __init__(
+        self,
+        metric: Callable,
+        n_clusters: int,
+        init: Union[str, DNDarray],
+        max_iter: int,
+        tol: float,
+        random_state: Optional[int],
+    ):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.max_iter = max_iter
+        self.tol = tol
+        self.random_state = random_state
+
+        self._metric = metric
+        self._cluster_centers = None
+        self._labels = None
+        self._inertia = None
+        self._n_iter = None
+
+        if random_state is not None:
+            ht_random.seed(random_state)
+
+    @property
+    def cluster_centers_(self) -> DNDarray:
+        """Coordinates of the cluster centers."""
+        return self._cluster_centers
+
+    @property
+    def labels_(self) -> DNDarray:
+        """Label of each sample point."""
+        return self._labels
+
+    @property
+    def inertia_(self) -> float:
+        """Sum of squared distances of samples to their closest center."""
+        return self._inertia
+
+    @property
+    def n_iter_(self) -> int:
+        """Number of iterations run."""
+        return self._n_iter
+
+    # ------------------------------------------------------------------ #
+    # initialization (reference: _kcluster.py:87-187)                    #
+    # ------------------------------------------------------------------ #
+    def _initialize_cluster_centers(self, x: DNDarray) -> None:
+        k = self.n_clusters
+        n, d = x.shape
+        arr = x.larray
+        if types.heat_type_is_exact(x.dtype):
+            arr = arr.astype(jnp.float32)
+
+        if isinstance(self.init, DNDarray):
+            if self.init.shape != (k, d):
+                raise ValueError(
+                    f"passed centroids need to be of shape ({k}, {d}), got {self.init.shape}"
+                )
+            centers = self.init.larray.astype(arr.dtype)
+        elif isinstance(self.init, str) and self.init == "random":
+            # k observations drawn at random from the data (reference:
+            # per-centroid rank-owned row + Bcast; here a global gather)
+            idx = ht_random.randperm(n, comm=x.comm).larray[:k]
+            centers = jnp.take(arr, idx, axis=0)
+        elif isinstance(self.init, str) and self.init in ("probability_based", "kmeans++", "k-means++"):
+            centers = self._kmeanspp(arr, k)
+        else:
+            raise ValueError(f"initialization needs to be 'random', 'probability_based' or a DNDarray, got {self.init}")
+
+        # centers are replicated (small k×d)
+        self._cluster_centers = DNDarray(
+            jax.device_put(centers, x.comm.sharding(2, None)),
+            (k, d),
+            types.canonical_heat_type(centers.dtype),
+            None,
+            x.device,
+            x.comm,
+        )
+
+    def _kmeanspp(self, arr: jax.Array, k: int) -> jax.Array:
+        """Greedy k-means++ seeding on the sharded global array (reference:
+        _kcluster.py:123-187 draws one candidate per step with per-centroid
+        owner-rank broadcasts; here the sklearn-style greedy variant draws
+        2+log(k) candidates per step and keeps the one minimizing the
+        potential — markedly more robust seeding at negligible cost)."""
+        n = arr.shape[0]
+        n_candidates = 2 + int(np.log(max(k, 2)))
+        state = ht_random.get_state()
+        key = jax.random.fold_in(jax.random.PRNGKey(int(state[1])), int(state[2]))
+        ht_random.set_state((state[0], state[1], state[2] + k, 0, 0.0))
+        keys = jax.random.split(key, k)
+        first = jax.random.randint(keys[0], (), 0, n)
+        centers = jnp.zeros((k, arr.shape[1]), dtype=arr.dtype).at[0].set(arr[first])
+        d2 = jnp.sum((arr - centers[0]) ** 2, axis=1)
+        for i in range(1, k):
+            probs = d2 / jnp.maximum(jnp.sum(d2), 1e-30)
+            cand = jax.random.choice(keys[i], n, shape=(n_candidates,), p=probs)
+            cand_pts = jnp.take(arr, cand, axis=0)  # (L, d)
+            cand_d2 = jnp.sum((arr[None, :, :] - cand_pts[:, None, :]) ** 2, axis=2)  # (L, n)
+            potentials = jnp.sum(jnp.minimum(d2[None, :], cand_d2), axis=1)  # (L,)
+            best = jnp.argmin(potentials)
+            centers = centers.at[i].set(cand_pts[best])
+            d2 = jnp.minimum(d2, cand_d2[best])
+        return centers
+
+    # ------------------------------------------------------------------ #
+    # assignment (reference: _kcluster.py:196-209)                       #
+    # ------------------------------------------------------------------ #
+    _assignment_metric = "euclidean"
+
+    def _assign_to_cluster(self, x: DNDarray, eval_functional_value: bool = False) -> DNDarray:
+        """Label of the closest center for every sample, using the
+        subclass's assignment metric (reference passes cdist or manhattan
+        into _KCluster; kmedians/kmedoids use L1)."""
+        sanitize_in(x)
+        arr = x.larray
+        if types.heat_type_is_exact(x.dtype):
+            arr = arr.astype(jnp.float32)
+        c = self._cluster_centers.larray
+        d = self._pairwise(arr, c, self._assignment_metric)
+        labels = jnp.argmin(d, axis=1).astype(jnp.int64)
+        if eval_functional_value:
+            if self._assignment_metric == "manhattan":
+                # L1 functional value
+                self._inertia = float(jnp.sum(jnp.min(d, axis=1)))
+            else:
+                self._inertia = float(jnp.sum(jnp.min(d, axis=1) ** 2))
+        gshape = (x.shape[0],)
+        split = 0 if x.split is not None else None
+        if split is not None:
+            labels = x.comm.shard(labels, split)
+        return DNDarray(labels, gshape, types.int64, split, x.device, x.comm)
+
+    @staticmethod
+    def _pairwise(arr: jax.Array, c: jax.Array, metric: str = "euclidean") -> jax.Array:
+        """Pairwise sample×center distances: Euclidean via the MXU-friendly
+        quadratic expansion, or Manhattan for the L1 family."""
+        if metric == "manhattan":
+            return jnp.sum(jnp.abs(arr[:, None, :] - c[None, :, :]), axis=-1)
+        x2 = jnp.sum(arr * arr, axis=1, keepdims=True)
+        c2 = jnp.sum(c * c, axis=1, keepdims=True).T
+        return jnp.sqrt(jnp.maximum(x2 + c2 - 2.0 * (arr @ c.T), 0.0))
+
+    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):
+        raise NotImplementedError()
+
+    def fit(self, x: DNDarray):
+        raise NotImplementedError()
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Labels of the closest cluster center for new data (reference:
+        _kcluster.py predict)."""
+        sanitize_in(x)
+        if self._cluster_centers is None:
+            raise RuntimeError("fit needs to be called before predict")
+        return self._assign_to_cluster(x)

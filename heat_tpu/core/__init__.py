@@ -4,6 +4,7 @@ Mirrors the reference layout /root/reference/heat/core/__init__.py — the
 flat ``ht.*`` namespace re-exports every surface module.
 """
 
+from .base import *
 from .communication import *
 from .constants import *
 from .devices import *

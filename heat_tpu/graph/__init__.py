@@ -1,0 +1,3 @@
+"""Distributed graph algorithms (reference: /root/reference/heat/graph/)."""
+
+from .laplacian import *

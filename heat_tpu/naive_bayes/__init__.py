@@ -1,0 +1,3 @@
+"""Distributed naive Bayes (reference: /root/reference/heat/naive_bayes/)."""
+
+from .gaussianNB import *

@@ -1,0 +1,3 @@
+"""Data preprocessing (reference: /root/reference/heat/preprocessing/)."""
+
+from .preprocessing import *

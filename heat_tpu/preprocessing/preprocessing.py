@@ -1,0 +1,226 @@
+"""Feature scaling transformers.
+
+API parity with /root/reference/heat/preprocessing/preprocessing.py
+(``StandardScaler`` :49, ``MinMaxScaler`` :158, ``Normalizer`` :284,
+``MaxAbsScaler`` :358, ``RobustScaler`` :444). All statistics are sharded
+reductions over the sample axis (mean/var/min/max/percentile — one
+all-reduce each in the reference's terms).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from typing import Optional, Tuple
+
+from ..core import statistics, types
+from ..core.base import BaseEstimator, TransformMixin
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+
+__all__ = ["StandardScaler", "MinMaxScaler", "Normalizer", "MaxAbsScaler", "RobustScaler"]
+
+
+def _float_of(x: DNDarray):
+    return x.dtype if types.heat_type_is_inexact(x.dtype) else types.float32
+
+
+class StandardScaler(BaseEstimator, TransformMixin):
+    """Standardize features to zero mean and unit variance (reference:
+    preprocessing.py:49)."""
+
+    def __init__(self, copy: bool = True, with_mean: bool = True, with_std: bool = True):
+        self.copy = copy
+        self.with_mean = with_mean
+        self.with_std = with_std
+        self.mean_ = None
+        self.var_ = None
+
+    def fit(self, x: DNDarray, sample_weight=None) -> "StandardScaler":
+        sanitize_in(x)
+        self.mean_ = statistics.mean(x, axis=0) if self.with_mean or self.with_std else None
+        if self.with_std:
+            self.var_ = statistics.var(x, axis=0)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        sanitize_in(x)
+        arr = x.larray.astype(_float_of(x).jax_type())
+        if self.with_mean and self.mean_ is not None:
+            arr = arr - self.mean_.larray
+        if self.with_std and self.var_ is not None:
+            scale = jnp.sqrt(self.var_.larray)
+            arr = arr / jnp.where(scale > 0, scale, 1.0)
+        return _like(x, arr)
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        sanitize_in(y)
+        arr = y.larray
+        if self.with_std and self.var_ is not None:
+            scale = jnp.sqrt(self.var_.larray)
+            arr = arr * jnp.where(scale > 0, scale, 1.0)
+        if self.with_mean and self.mean_ is not None:
+            arr = arr + self.mean_.larray
+        return _like(y, arr)
+
+
+def _like(x: DNDarray, arr) -> DNDarray:
+    gshape = tuple(int(s) for s in arr.shape)
+    split = x.split
+    if split is not None:
+        arr = x.comm.shard(arr, split)
+    return DNDarray(
+        arr, gshape, types.canonical_heat_type(arr.dtype), split, x.device, x.comm
+    )
+
+
+class MinMaxScaler(BaseEstimator, TransformMixin):
+    """Scale features to a given range (reference: preprocessing.py:158)."""
+
+    def __init__(self, feature_range: Tuple[float, float] = (0.0, 1.0), copy: bool = True, clip: bool = False):
+        if feature_range[0] >= feature_range[1]:
+            raise ValueError(f"minimum of feature_range must be smaller than maximum, got {feature_range}")
+        self.feature_range = feature_range
+        self.copy = copy
+        self.clip = clip
+        self.data_min_ = None
+        self.data_max_ = None
+        self.data_range_ = None
+        self.min_ = None
+        self.scale_ = None
+
+    def fit(self, x: DNDarray) -> "MinMaxScaler":
+        sanitize_in(x)
+        self.data_min_ = statistics.min(x, axis=0)
+        self.data_max_ = statistics.max(x, axis=0)
+        rng = self.data_max_.larray - self.data_min_.larray
+        rng = jnp.where(rng > 0, rng, 1.0)
+        lo, hi = self.feature_range
+        scale = (hi - lo) / rng
+        self.scale_ = scale
+        self.min_ = lo - self.data_min_.larray * scale
+        self.data_range_ = rng
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        sanitize_in(x)
+        arr = x.larray.astype(jnp.result_type(self.scale_.dtype))
+        arr = arr * self.scale_ + self.min_
+        if self.clip:
+            arr = jnp.clip(arr, self.feature_range[0], self.feature_range[1])
+        return _like(x, arr)
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        sanitize_in(y)
+        arr = (y.larray - self.min_) / self.scale_
+        return _like(y, arr)
+
+
+class Normalizer(BaseEstimator, TransformMixin):
+    """Normalize samples to unit norm (reference: preprocessing.py:284)."""
+
+    def __init__(self, norm: str = "l2", copy: bool = True):
+        if norm not in ("l1", "l2", "max"):
+            raise NotImplementedError(f"unsupported norm {norm}")
+        self.norm = norm
+        self.copy = copy
+
+    def fit(self, x: DNDarray) -> "Normalizer":
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        sanitize_in(x)
+        arr = x.larray.astype(_float_of(x).jax_type())
+        if self.norm == "l2":
+            norms = jnp.sqrt(jnp.sum(arr * arr, axis=1, keepdims=True))
+        elif self.norm == "l1":
+            norms = jnp.sum(jnp.abs(arr), axis=1, keepdims=True)
+        else:
+            norms = jnp.max(jnp.abs(arr), axis=1, keepdims=True)
+        arr = arr / jnp.where(norms > 0, norms, 1.0)
+        return _like(x, arr)
+
+
+class MaxAbsScaler(BaseEstimator, TransformMixin):
+    """Scale by the per-feature maximum absolute value (reference:
+    preprocessing.py:358)."""
+
+    def __init__(self, copy: bool = True):
+        self.copy = copy
+        self.max_abs_ = None
+        self.scale_ = None
+
+    def fit(self, x: DNDarray) -> "MaxAbsScaler":
+        sanitize_in(x)
+        arr = x.larray
+        max_abs = jnp.max(jnp.abs(arr), axis=0)
+        self.max_abs_ = max_abs
+        self.scale_ = jnp.where(max_abs > 0, max_abs, 1.0)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        sanitize_in(x)
+        arr = x.larray.astype(_float_of(x).jax_type()) / self.scale_
+        return _like(x, arr)
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        sanitize_in(y)
+        return _like(y, y.larray * self.scale_)
+
+
+class RobustScaler(BaseEstimator, TransformMixin):
+    """Scale by median and IQR (reference: preprocessing.py:444 — uses the
+    distributed percentile)."""
+
+    def __init__(
+        self,
+        quantile_range: Tuple[float, float] = (25.0, 75.0),
+        copy: bool = True,
+        with_centering: bool = True,
+        with_scaling: bool = True,
+        unit_variance: bool = False,
+    ):
+        q_min, q_max = quantile_range
+        if not 0 <= q_min <= q_max <= 100:
+            raise ValueError(f"invalid quantile range {quantile_range}")
+        if unit_variance:
+            raise NotImplementedError("unit_variance rescaling is not yet supported (reference parity)")
+        self.quantile_range = quantile_range
+        self.copy = copy
+        self.with_centering = with_centering
+        self.with_scaling = with_scaling
+        self.unit_variance = unit_variance
+        self.center_ = None
+        self.iqr_ = None
+
+    def fit(self, x: DNDarray) -> "RobustScaler":
+        sanitize_in(x)
+        if self.with_centering:
+            self.center_ = statistics.median(x, axis=0)
+        if self.with_scaling:
+            q_min, q_max = self.quantile_range
+            lo = statistics.percentile(x, q_min, axis=0)
+            hi = statistics.percentile(x, q_max, axis=0)
+            iqr = hi.larray - lo.larray
+            self.iqr_ = jnp.where(iqr > 0, iqr, 1.0)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        sanitize_in(x)
+        arr = x.larray.astype(_float_of(x).jax_type())
+        if self.with_centering and self.center_ is not None:
+            arr = arr - self.center_.larray
+        if self.with_scaling and self.iqr_ is not None:
+            arr = arr / self.iqr_
+        return _like(x, arr)
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        sanitize_in(y)
+        arr = y.larray
+        if self.with_scaling and self.iqr_ is not None:
+            arr = arr * self.iqr_
+        if self.with_centering and self.center_ is not None:
+            arr = arr + self.center_.larray
+        return _like(y, arr)

@@ -1,0 +1,3 @@
+"""Distributed regression (reference: /root/reference/heat/regression/)."""
+
+from .lasso import *

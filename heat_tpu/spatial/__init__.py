@@ -1,0 +1,3 @@
+"""Distributed spatial algorithms (reference: /root/reference/heat/spatial/)."""
+
+from .distance import *

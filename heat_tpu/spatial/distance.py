@@ -1,0 +1,104 @@
+"""Pairwise distance computations.
+
+API parity with /root/reference/heat/spatial/distance.py (``cdist`` :135,
+``rbf`` :158, ``manhattan`` :185). The reference's ``_dist`` (:208-477) is
+a **ring pipeline**: each rank keeps a stationary block of X and passes a
+moving block of Y around the ring for (size+1)//2 iterations, exploiting
+symmetry when X ≡ Y — exactly the ring-attention schedule. On TPU the
+same dataflow comes out of one sharded matmul-based distance expression:
+GSPMD partitions the (n × m) distance computation over the row shards and
+emits the rotating collectives on ICI; the quadratic-expansion form
+(‖x‖² + ‖y‖² − 2x·yᵀ) maps the inner product onto the MXU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from typing import Callable, Optional
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+
+__all__ = ["cdist", "manhattan", "rbf"]
+
+
+def _prepare(X: DNDarray, Y: Optional[DNDarray]):
+    sanitize_in(X)
+    if X.ndim != 2:
+        raise ValueError(f"X must be 2-dimensional, got {X.ndim}")
+    promoted = types.float32 if not types.heat_type_is_inexact(X.dtype) else X.dtype
+    if Y is not None:
+        sanitize_in(Y)
+        if Y.ndim != 2:
+            raise ValueError(f"Y must be 2-dimensional, got {Y.ndim}")
+        if X.shape[1] != Y.shape[1]:
+            raise ValueError(
+                f"X and Y must have the same feature dimension, got {X.shape[1]} != {Y.shape[1]}"
+            )
+        if types.heat_type_is_inexact(Y.dtype):
+            promoted = types.promote_types(promoted, Y.dtype)
+    if promoted is types.float64:
+        jt = jnp.float64
+    else:
+        jt = jnp.float32
+        promoted = types.float32
+    x = X.larray.astype(jt)
+    y = x if Y is None else Y.larray.astype(jt)
+    return x, y, promoted
+
+
+def _wrap(result: jax.Array, X: DNDarray, Y: Optional[DNDarray], dtype) -> DNDarray:
+    # result split rule (reference distance.py: output split follows X's
+    # sample axis; Y split along axis 0 maps to output axis 1)
+    split = 0 if X.split == 0 else (1 if (Y is not None and Y.split == 0) else None)
+    gshape = tuple(int(s) for s in result.shape)
+    if split is not None:
+        result = X.comm.shard(result, split)
+    return DNDarray(result, gshape, dtype, split, X.device, X.comm)
+
+
+def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool = False) -> DNDarray:
+    """Pairwise Euclidean distances (reference: distance.py:135)."""
+    x, y, dtype = _prepare(X, Y)
+    if quadratic_expansion:
+        # MXU form: ‖x‖² + ‖y‖² − 2 x·yᵀ
+        x2 = jnp.sum(x * x, axis=1, keepdims=True)
+        y2 = jnp.sum(y * y, axis=1, keepdims=True).T
+        d2 = jnp.maximum(x2 + y2 - 2.0 * (x @ y.T), 0.0)
+        result = jnp.sqrt(d2)
+    else:
+        diff = x[:, None, :] - y[None, :, :]
+        result = jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    return _wrap(result, X, Y, dtype)
+
+
+def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False) -> DNDarray:
+    """Pairwise L1 distances (reference: distance.py:185)."""
+    x, y, dtype = _prepare(X, Y)
+    diff = jnp.abs(x[:, None, :] - y[None, :, :])
+    result = jnp.sum(diff, axis=-1)
+    return _wrap(result, X, Y, dtype)
+
+
+def rbf(
+    X: DNDarray,
+    Y: Optional[DNDarray] = None,
+    sigma: float = 1.0,
+    quadratic_expansion: bool = False,
+) -> DNDarray:
+    """RBF kernel exp(−d²/(2σ²)) (reference: distance.py:158)."""
+    x, y, dtype = _prepare(X, Y)
+    if quadratic_expansion:
+        x2 = jnp.sum(x * x, axis=1, keepdims=True)
+        y2 = jnp.sum(y * y, axis=1, keepdims=True).T
+        d2 = jnp.maximum(x2 + y2 - 2.0 * (x @ y.T), 0.0)
+    else:
+        diff = x[:, None, :] - y[None, :, :]
+        d2 = jnp.sum(diff * diff, axis=-1)
+    result = jnp.exp(-d2 / (2.0 * sigma * sigma))
+    return _wrap(result, X, Y, dtype)

@@ -1,0 +1,3 @@
+"""Utilities (reference: /root/reference/heat/utils/)."""
+
+from . import data

@@ -1,0 +1,5 @@
+"""Data utilities (reference: /root/reference/heat/utils/data/)."""
+
+from . import matrixgallery
+from . import spherical
+from .spherical import create_spherical_dataset

@@ -1,0 +1,132 @@
+"""Test/benchmark matrix generators.
+
+API parity with /root/reference/heat/utils/data/matrixgallery.py
+(``hermitian``, ``parter``, ``random_orthogonal``,
+``random_known_singularvalues``, ``random_known_rank``) — fixtures for the
+linalg tests and the hSVD benchmarks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from typing import Callable, Optional, Tuple, Union
+
+from ...core import factories, random as ht_random, types
+from ...core.dndarray import DNDarray
+from ...core.linalg import matmul, qr, transpose
+
+__all__ = [
+    "hermitian",
+    "parter",
+    "random_orthogonal",
+    "random_known_singularvalues",
+    "random_known_rank",
+]
+
+
+def hermitian(n: int, dtype=types.complex64, split=None, device=None, comm=None) -> DNDarray:
+    """Random hermitian (or symmetric, for real dtype) n×n matrix
+    (reference: matrixgallery.py hermitian)."""
+    dtype = types.canonical_heat_type(dtype)
+    if types.heat_type_is_complexfloating(dtype):
+        real = ht_random.randn(n, n, split=split, device=device, comm=comm)
+        imag = ht_random.randn(n, n, split=split, device=device, comm=comm)
+        arr = real.larray + 1j * imag.larray
+        a = DNDarray(
+            real.comm.shard(arr.astype(dtype.jax_type()), real.split),
+            (n, n),
+            dtype,
+            real.split,
+            real.device,
+            real.comm,
+        )
+        out_arr = (a.larray + jnp.conj(a.larray).T) / 2
+    else:
+        a = ht_random.randn(n, n, split=split, device=device, comm=comm, dtype=dtype)
+        out_arr = (a.larray + a.larray.T) / 2
+    return DNDarray(
+        a.comm.shard(out_arr, a.split) if a.split is not None else out_arr,
+        (n, n),
+        dtype,
+        a.split,
+        a.device,
+        a.comm,
+    )
+
+
+def parter(n: int, split=None, device=None, comm=None, dtype=types.float32) -> DNDarray:
+    """Parter matrix: Cauchy matrix with singular values near π
+    (reference: matrixgallery.py parter)."""
+    ii = factories.arange(n, dtype=types.float32, split=None, device=device, comm=comm)
+    arr = 1.0 / (ii.larray[:, None] - ii.larray[None, :] + 0.5)
+    dtype = types.canonical_heat_type(dtype)
+    comm_ = ii.comm
+    from ...core.stride_tricks import sanitize_axis
+
+    split = sanitize_axis((n, n), split)
+    out = arr.astype(dtype.jax_type())
+    if split is not None:
+        out = comm_.shard(out, split)
+    return DNDarray(out, (n, n), dtype, split, ii.device, comm_)
+
+
+def random_orthogonal(m: int, n: int, split=None, device=None, comm=None, dtype=types.float32) -> DNDarray:
+    """Random m×n matrix with orthonormal columns (requires m >= n;
+    reference: matrixgallery.py random_orthogonal)."""
+    if m < n:
+        raise ValueError(f"m >= n required, got {m} < {n}")
+    a = ht_random.randn(m, n, dtype=types.canonical_heat_type(dtype), split=split, device=device, comm=comm)
+    q, _ = qr(a)
+    return q
+
+
+def random_known_singularvalues(
+    m: int, n: int, singular_values: DNDarray, split=None, device=None, comm=None, dtype=types.float32
+) -> Tuple[DNDarray, Tuple[DNDarray, DNDarray]]:
+    """Random matrix with prescribed singular values (reference:
+    matrixgallery.py random_known_singularvalues). Returns
+    (A, (U, V))."""
+    if isinstance(singular_values, DNDarray):
+        k = singular_values.shape[0]
+        s = singular_values.larray
+    else:
+        s = jnp.asarray(np.asarray(singular_values))
+        k = int(s.shape[0])
+    if k > min(m, n):
+        raise ValueError(f"number of singular values {k} exceeds min(m, n)={min(m, n)}")
+    U = random_orthogonal(m, k, split=split, device=device, comm=comm, dtype=dtype)
+    V = random_orthogonal(n, k, split=split, device=device, comm=comm, dtype=dtype)
+    us = U.larray * s
+    A_arr = us @ V.larray.T
+    comm_ = U.comm
+    from ...core.stride_tricks import sanitize_axis
+
+    split = sanitize_axis((m, n), split)
+    if split is not None:
+        A_arr = comm_.shard(A_arr, split)
+    A = DNDarray(A_arr, (m, n), types.canonical_heat_type(dtype), split, U.device, comm_)
+    s_arr = factories.array(np.asarray(s), comm=comm_)
+    return A, (U, s_arr, V)
+
+
+def random_known_rank(
+    m: int,
+    n: int,
+    r: int,
+    quantile_function: Callable = lambda x: -np.log(x),
+    split=None,
+    device=None,
+    comm=None,
+    dtype=types.float32,
+) -> Tuple[DNDarray, Tuple[DNDarray, DNDarray]]:
+    """Random matrix of known rank r with singular values drawn through
+    ``quantile_function`` (reference: matrixgallery.py random_known_rank)."""
+    if r > min(m, n):
+        raise ValueError(f"rank {r} exceeds min(m, n)={min(m, n)}")
+    # draw through the framework RNG so ht.random.seed governs the fixture
+    u = np.sort(np.asarray(ht_random.rand(r).numpy()))[::-1]
+    s = np.asarray([quantile_function(x) for x in u], dtype=np.float32)
+    return random_known_singularvalues(m, n, factories.array(s), split=split, device=device, comm=comm, dtype=dtype)

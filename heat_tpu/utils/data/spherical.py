@@ -1,0 +1,52 @@
+"""Synthetic spherical cluster data.
+
+API parity with /root/reference/heat/utils/data/spherical.py
+(``create_spherical_dataset``): four 3-D gaussian clusters at ±offset used
+by the clustering benchmarks and tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core import factories, manipulations, random as ht_random, types
+from ...core.dndarray import DNDarray
+
+__all__ = ["create_spherical_dataset"]
+
+
+def create_spherical_dataset(
+    num_samples_cluster: int,
+    radius: float = 1.0,
+    offset: float = 4.0,
+    dtype=types.float32,
+    random_state: int = 1,
+) -> DNDarray:
+    """Four spherical clusters of ``num_samples_cluster`` 3-D points each,
+    uniformly distributed inside spheres of the given ``radius`` centered
+    at (±offset, ±2·offset) on the diagonal (reference: spherical.py —
+    same centers and bounded spread)."""
+    ht_random.seed(random_state)
+    n = int(num_samples_cluster)
+    parts = []
+    for sign in (-2.0, -1.0, 1.0, 2.0):
+        center = float(sign) * offset
+        # uniform inside the sphere: gaussian direction × U^(1/3) radius
+        direction = ht_random.randn(n, 3, dtype=types.canonical_heat_type(dtype))
+        u = ht_random.rand(n, 1, dtype=types.canonical_heat_type(dtype))
+        d_arr = direction.larray
+        norms = (d_arr / jnp.maximum(jnp.linalg.norm(d_arr, axis=1, keepdims=True), 1e-30))
+        pts = norms * (u.larray ** (1.0 / 3.0)) * radius + center
+        blob = DNDarray(
+            direction.comm.shard(pts, direction.split),
+            (n, 3),
+            direction.dtype,
+            direction.split,
+            direction.device,
+            direction.comm,
+        )
+        parts.append(blob)
+    data = manipulations.concatenate(parts, axis=0)
+    return data.resplit(0)
