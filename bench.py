@@ -1,0 +1,223 @@
+"""Benchmark harness — the TPU analog of the reference's continuous
+benchmarks (/root/reference/benchmarks/cb/{linalg,cluster,manipulations}.py).
+
+Runs the cb workload set on the default JAX platform (the real TPU chip
+under the driver) and prints ONE JSON line::
+
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
+
+Headline metric: ``hsvd_rank`` GB/s/chip (BASELINE.json north star).
+
+``vs_baseline`` compares against the reference's compute engine executing
+the same workload: single-process reference Heat short-circuits all MPI
+paths and runs plain torch CPU kernels (torch.linalg.svd is exactly
+``compute_local_truncated_svd``, reference svdtools.py:477). mpi4py is not
+installed in this image, so the reference itself cannot run; torch-CPU is
+the closest faithful stand-in. Baseline timings are measured once with
+``python bench.py --measure-baseline`` and cached in BENCH_BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+
+# workload sizes (single chip; reference cb sizes where they fit)
+N_MATMUL = 3000          # benchmarks/cb/linalg.py:45
+N_QR = 2000              # benchmarks/cb/linalg.py:55
+HSVD_M, HSVD_N, HSVD_R = 16384, 2048, 10   # tall-skinny split-0 north star
+KM_N, KM_D, KM_K = 1_048_576, 64, 8        # KMeans iter/s at scale
+RESHAPE_SHAPE = (1000, 250_000)            # cb uses 1000x10M..40M on a cluster
+CONCAT_SIZES = (10_000, 20_000, 40_000)    # benchmarks/cb/manipulations.py:20
+SUM_N = 100_000_000
+
+
+def _best_of(fn, reps: int = 3) -> float:
+    fn()  # warmup / compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+# --------------------------------------------------------------------- #
+# torch-CPU baseline (reference compute engine, single process)         #
+# --------------------------------------------------------------------- #
+def measure_baseline() -> dict:
+    import torch
+
+    torch.manual_seed(0)
+    out = {}
+
+    a = torch.randn(N_MATMUL, N_MATMUL)
+    b = torch.randn(N_MATMUL, N_MATMUL)
+    out["matmul"] = _best_of(lambda: a @ b)
+    del a, b
+
+    c = torch.randn(N_QR, N_QR)
+    out["qr"] = _best_of(lambda: torch.linalg.qr(c), reps=2)
+    del c
+
+    d = torch.randn(HSVD_M, HSVD_N)
+    def _hsvd_ref():
+        u, s, vt = torch.linalg.svd(d, full_matrices=False)
+        return u[:, :HSVD_R], s[:HSVD_R]
+    out["hsvd"] = _best_of(_hsvd_ref, reps=1)
+    del d
+
+    x = torch.randn(KM_N, KM_D)
+    cent = x[:KM_K].clone()
+    def _km_iter():
+        d2 = torch.cdist(x, cent)
+        labels = d2.argmin(dim=1)
+        oh = torch.nn.functional.one_hot(labels, KM_K).to(x.dtype)
+        sums = oh.T @ x
+        counts = oh.sum(dim=0).clamp(min=1)
+        return sums / counts[:, None]
+    out["kmeans_iter"] = _best_of(_km_iter, reps=1)
+    del x, cent
+
+    r = torch.zeros(RESHAPE_SHAPE)
+    out["reshape"] = _best_of(lambda: r.reshape(10_000_000, -1).contiguous(), reps=2)
+    del r
+
+    arrs = [torch.zeros(1000, s) for s in CONCAT_SIZES]
+    out["concatenate"] = _best_of(lambda: torch.cat(arrs, dim=1), reps=2)
+    del arrs
+
+    s_in = torch.arange(SUM_N, dtype=torch.float32)
+    out["sum"] = _best_of(lambda: s_in.sum())
+    del s_in
+
+    out["_meta"] = {
+        "engine": "torch-cpu",
+        "torch": torch.__version__,
+        "threads": torch.get_num_threads(),
+        "note": "reference Heat single-process == local torch kernels (mpi4py absent)",
+    }
+    return out
+
+
+# --------------------------------------------------------------------- #
+# heat_tpu measurements                                                 #
+# --------------------------------------------------------------------- #
+def measure_heat_tpu() -> dict:
+    import jax
+    import numpy as np
+    import heat_tpu as ht
+
+    def sync(x):
+        # jax.block_until_ready is a no-op over the remote-execution tunnel;
+        # a scalar host read-back (~8 µs floor) forces producer completion.
+        arr = x._phys if hasattr(x, "_phys") else x
+        np.asarray(jax.device_get(arr[(0,) * arr.ndim] if arr.ndim else arr))
+
+    out = {"_meta": {"platform": jax.devices()[0].platform,
+                     "device": str(jax.devices()[0]),
+                     "n_devices": len(jax.devices())}}
+
+    ht.random.seed(0)
+
+    a = ht.random.random((N_MATMUL, N_MATMUL), split=0)
+    b = ht.random.random((N_MATMUL, N_MATMUL), split=0)
+    out["matmul"] = _best_of(lambda: sync(ht.matmul(a, b)))
+    a1 = a.resplit(1); b1 = b.resplit(1)
+    out["matmul_split1"] = _best_of(lambda: sync(ht.matmul(a1, b1)))
+    del a, b, a1, b1
+
+    c0 = ht.random.random((N_QR, N_QR), split=0)
+    out["qr"] = _best_of(lambda: sync(ht.linalg.qr(c0)[0]), reps=2)
+    del c0
+
+    d = ht.random.random((HSVD_M, HSVD_N), split=0)
+    out["hsvd"] = _best_of(lambda: sync(ht.linalg.hsvd_rank(d, HSVD_R)[0]), reps=2)
+    del d
+
+    from heat_tpu.cluster.kmeans import _lloyd_step
+    x = ht.random.randn(KM_N, KM_D, split=0)
+    cent = x.larray[:KM_K]
+    step = _lloyd_step(KM_K, tuple(x.larray.shape), np.dtype(x.larray.dtype).name)
+    out["kmeans_iter"] = _best_of(lambda: sync(step(x.larray, cent)[0]))
+    del x, cent
+
+    # cb cluster config: full fit on 4x5000 spherical samples, kmeans++
+    from heat_tpu.utils.data.spherical import create_spherical_dataset
+    data = create_spherical_dataset(num_samples_cluster=5000, radius=1.0, offset=4.0,
+                                    dtype=ht.float32, random_state=1)
+    def _km_fit():
+        km = ht.cluster.KMeans(n_clusters=4, init="kmeans++", random_state=1)
+        km.fit(data)
+        sync(km.cluster_centers_)
+    out["kmeans_fit_cb"] = _best_of(_km_fit, reps=2)
+    del data
+
+    r = ht.zeros(RESHAPE_SHAPE, split=1)
+    out["reshape"] = _best_of(lambda: sync(ht.reshape(r, (10_000_000, -1), new_split=1)), reps=2)
+    del r
+
+    arrs = [ht.zeros((1000, s), split=(None if i == 1 else 1)) for i, s in enumerate(CONCAT_SIZES)]
+    out["concatenate"] = _best_of(lambda: sync(ht.concatenate(arrs, axis=1)), reps=2)
+    del arrs
+
+    s_in = ht.arange(SUM_N, dtype=ht.float32, split=0)
+    out["sum"] = _best_of(lambda: sync(ht.sum(s_in)))
+    del s_in
+
+    return out
+
+
+def main() -> None:
+    if "--measure-baseline" in sys.argv:
+        base = measure_baseline()
+        with open(BASELINE_FILE, "w") as f:
+            json.dump(base, f, indent=2)
+        print(json.dumps({"written": BASELINE_FILE, **{k: v for k, v in base.items() if k != "_meta"}}))
+        return
+
+    ours = measure_heat_tpu()
+    base = {}
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            base = json.load(f)
+
+    hsvd_bytes = HSVD_M * HSVD_N * 4
+    hsvd_gbps = hsvd_bytes / ours["hsvd"] / 1e9
+    hsvd_base_gbps = hsvd_bytes / base["hsvd"] / 1e9 if base.get("hsvd") else None
+
+    detail = {}
+    for k, t_ours in ours.items():
+        if k.startswith("_"):
+            continue
+        entry = {"seconds": round(t_ours, 6)}
+        bkey = "matmul" if k == "matmul_split1" else k
+        # reshape is excluded: on one torch process it is a free view, while
+        # new_split=1 does real repartition work — not comparable.
+        if base.get(bkey) and k != "reshape":
+            entry["speedup_vs_torch_cpu"] = round(base[bkey] / t_ours, 3)
+        detail[k] = entry
+    # derived throughputs
+    detail["matmul"]["gflops"] = round(2 * N_MATMUL**3 / ours["matmul"] / 1e9, 1)
+    detail["kmeans_iter"]["iter_per_s"] = round(1.0 / ours["kmeans_iter"], 2)
+    detail["sum"]["gbps"] = round(SUM_N * 4 / ours["sum"] / 1e9, 2)
+    detail["hsvd"]["gbps"] = round(hsvd_gbps, 2)
+
+    result = {
+        "metric": f"hsvd_rank(r={HSVD_R}) GB/s/chip on {HSVD_M}x{HSVD_N} f32 split=0",
+        "value": round(hsvd_gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(hsvd_gbps / hsvd_base_gbps, 3) if hsvd_base_gbps else None,
+        "baseline": "reference engine (torch-CPU single-process Heat path), BENCH_BASELINE.json",
+        "platform": ours["_meta"],
+        "detail": detail,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

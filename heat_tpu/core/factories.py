@@ -69,6 +69,14 @@ def _cached_creator(mesh, axis_name: str, op_key: str, shape, jdtype, split, arg
         spec = PartitionSpec(*(axis_name if i == split else None for i in range(len(shape))))
     sharding = NamedSharding(mesh, spec)
 
+    # NOTE: sequence builders must stay symbolic (lax.iota). jnp.arange/
+    # linspace/eye with static args evaluate eagerly even under trace and
+    # the resulting array is embedded into the HLO as a full constant —
+    # a 100M-element ht.arange then ships a 400 MB compile request.
+    def _iota_1d(n):
+        wide = jnp.int64 if jnp.issubdtype(jnp.dtype(jdtype), jnp.integer) else jnp.float64
+        return jax.lax.iota(wide, n)
+
     def build():
         if op_key == "zeros":
             logical = jnp.zeros(shape, dtype=jdtype)
@@ -80,12 +88,21 @@ def _cached_creator(mesh, axis_name: str, op_key: str, shape, jdtype, split, arg
             logical = jnp.full(shape, args[0], dtype=jdtype)
         elif op_key == "arange":
             start, stop, step = args
-            logical = jnp.arange(start, stop, step, dtype=jdtype)
+            logical = (_iota_1d(shape[0]) * step + start).astype(jdtype)
         elif op_key == "linspace":
             start, stop, num, endpoint = args
-            logical = jnp.linspace(start, stop, num, endpoint=endpoint, dtype=jdtype)
+            div = (num - 1) if endpoint else num
+            delta = (stop - start) / div if div > 0 else 0.0
+            logical = jax.lax.iota(jnp.float64, num) * delta + start
+            if endpoint and num > 1:
+                # pin the final sample to stop exactly (np.linspace semantics;
+                # iota*delta accumulates one rounding step at the endpoint)
+                logical = logical.at[-1].set(stop)
+            logical = logical.astype(jdtype)
         elif op_key == "eye":
-            logical = jnp.eye(shape[0], shape[1] if len(shape) > 1 else None, dtype=jdtype)
+            rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1)
+            logical = (rows == cols).astype(jdtype)
         else:
             raise ValueError(op_key)
         return _padding.pad_logical(logical, split, size)
