@@ -28,6 +28,8 @@ from . import classification
 from . import cluster
 from . import graph
 from . import naive_bayes
+from . import nn
+from . import optim
 from . import preprocessing
 from . import regression
 from . import spatial

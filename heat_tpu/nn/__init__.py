@@ -1,0 +1,60 @@
+"""Deep-learning layer of heat_tpu.
+
+Parity with /root/reference/heat/nn/__init__.py: ``DataParallel`` /
+``DataParallelMultiGPU`` plus a layer namespace. The reference delegates
+unknown attributes to ``torch.nn`` (nn/__init__.py:19-47); here unknown
+attributes resolve to ``flax.linen`` — the JAX ecosystem's layer zoo —
+so e.g. ``ht.nn.Conv`` works without this package re-wrapping every layer.
+"""
+
+from .modules import (
+    Module,
+    Linear,
+    ReLU,
+    GELU,
+    Tanh,
+    Sigmoid,
+    LogSoftmax,
+    Softmax,
+    Flatten,
+    Dropout,
+    Sequential,
+    MSELoss,
+    NLLLoss,
+    CrossEntropyLoss,
+)
+from .data_parallel import DataParallel, DataParallelMultiGPU
+from . import functional
+from . import functional as F
+
+__all__ = [
+    "Module",
+    "Linear",
+    "ReLU",
+    "GELU",
+    "Tanh",
+    "Sigmoid",
+    "LogSoftmax",
+    "Softmax",
+    "Flatten",
+    "Dropout",
+    "Sequential",
+    "MSELoss",
+    "NLLLoss",
+    "CrossEntropyLoss",
+    "DataParallel",
+    "DataParallelMultiGPU",
+    "functional",
+    "F",
+]
+
+
+def __getattr__(name):
+    """Delegate unknown layer names to flax.linen (the analog of the
+    reference's torch.nn fallback, nn/__init__.py:19-47)."""
+    import flax.linen as _linen
+
+    try:
+        return getattr(_linen, name)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.nn' has no attribute '{name}'")

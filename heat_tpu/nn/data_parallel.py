@@ -1,0 +1,118 @@
+"""Data-parallel model wrapper.
+
+Replaces /root/reference/heat/nn/data_parallel.py:21-310 (``DataParallel``):
+the reference registers a backward hook on every parameter that issues a
+(blocking or non-blocking) ``Allreduce`` of the gradient, plus
+forward-pre-hooks that ``Wait`` on the previous iteration's handles — a
+hand-built overlap scheme. On TPU none of that machinery exists: the model
+parameters live REPLICATED on the mesh, the batch is sharded along axis 0,
+and the gradient of a mean-over-global-batch loss is automatically
+all-reduced by GSPMD inside the one jitted train step
+(see ``heat_tpu.optim.DataParallelOptimizer``). XLA overlaps the emitted
+collectives with compute on its own — the reference's wait-handle choreography
+(data_parallel.py:239-295) has no analog because it is not needed.
+
+``DataParallelMultiGPU`` (reference data_parallel.py:312: torch-DDP
+node-local + DASO global) maps to the two-level mesh inside
+``heat_tpu.optim.DASO``; the class here is a thin alias wiring the model to
+a DASO optimizer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from typing import Optional
+
+from ..core import types
+from ..core.communication import sanitize_comm
+from ..core.dndarray import DNDarray
+from .modules import Module
+
+__all__ = ["DataParallel", "DataParallelMultiGPU"]
+
+
+class DataParallel:
+    """Holds a module plus its parameters, replicated over the mesh.
+
+    Parameters
+    ----------
+    module : Module
+        The functional module (init/apply).
+    comm : Communication, optional
+        Device mesh; defaults to the global communicator.
+    key : int or jax.Array, optional
+        PRNG seed for parameter initialization.
+
+    The reference signature ``DataParallel(module, comm, optimizer,
+    blocking_parameter_updates)`` couples model and optimizer because the
+    grad hooks must reach into the optimizer; here the optimizer wraps the
+    model instead (``DataParallelOptimizer(opt, model)``) and no coupling
+    argument exists.
+    """
+
+    def __init__(self, module: Module, comm=None, key=0):
+        if not isinstance(module, Module):
+            raise TypeError(f"module must be a heat_tpu.nn.Module, got {type(module)}")
+        self.module = module
+        self.comm = sanitize_comm(comm)
+        if isinstance(key, int):
+            key = jax.random.PRNGKey(key)
+        params = module.init(key)
+        # replicate across the mesh: every device holds the full pytree
+        repl = self.comm.sharding(0, None)
+        self.params = jax.tree.map(lambda p: jax.device_put(p, repl), params)
+        # optimizers owning divergent per-node replicas (DASO) install a
+        # callable here so eval forwards always see current weights
+        self._param_override = None
+
+    def _current_params(self):
+        return self._param_override() if self._param_override is not None else self.params
+
+    def __call__(self, x, *, train: bool = False, key: Optional[jax.Array] = None):
+        """Forward pass. DNDarray in → DNDarray out (batch split preserved);
+        raw jax arrays pass through unchanged for use inside jitted steps."""
+        params = self._current_params()
+        if isinstance(x, DNDarray):
+            out = self.module.apply(params, x.larray, train=train, key=key)
+            split = x.split if x.split is not None and x.split < out.ndim else None
+            gshape = tuple(int(s) for s in out.shape)
+            phys = self.comm.shard(out, split)
+            return DNDarray(
+                phys, gshape, types.canonical_heat_type(out.dtype), split, x.device, self.comm
+            )
+        return self.module.apply(params, x, train=train, key=key)
+
+    forward = __call__
+
+    # ------------------------------------------------------------------ #
+    # reference-API conveniences                                         #
+    # ------------------------------------------------------------------ #
+    def parameters(self):
+        """Flat iterator over parameter leaves (reference: torch
+        ``module.parameters()``)."""
+        return iter(jax.tree.leaves(self.params))
+
+    def state_dict(self):
+        return self.params
+
+    def load_state_dict(self, params):
+        repl = self.comm.sharding(0, None)
+        self.params = jax.tree.map(lambda p: jax.device_put(jnp.asarray(p), repl), params)
+
+    def train(self):
+        return self
+
+    def eval(self):
+        return self
+
+
+class DataParallelMultiGPU(DataParallel):
+    """Reference data_parallel.py:312: node-local DDP + DASO global sync.
+    On TPU the hierarchy lives in the DASO optimizer's two-level mesh;
+    this subclass exists for API parity and simply tags the model so a
+    ``heat_tpu.optim.DASO`` optimizer can adopt it."""
+
+    def __init__(self, module: Module, comm=None, key=0):
+        super().__init__(module, comm, key)

@@ -1,0 +1,40 @@
+"""Functional neural-network ops.
+
+The reference's ``heat.nn.functional`` is a pass-through to
+``torch.nn.functional`` (/root/reference/heat/nn/functional.py:9); here the
+ecosystem equivalent is ``jax.nn``, re-exported with the common torch names
+so reference-style code ports mechanically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+relu = jax.nn.relu
+gelu = jax.nn.gelu
+sigmoid = jax.nn.sigmoid
+tanh = jnp.tanh
+softmax = jax.nn.softmax
+log_softmax = jax.nn.log_softmax
+softplus = jax.nn.softplus
+leaky_relu = jax.nn.leaky_relu
+elu = jax.nn.elu
+one_hot = jax.nn.one_hot
+
+
+def linear(x, weight, bias=None):
+    """y = x W (+ b) with weight stored (in, out) — see nn.Linear."""
+    y = x @ weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def __getattr__(name):
+    """Fall through to jax.nn for anything not aliased above (the analog of
+    the reference's torch.nn.functional delegation)."""
+    try:
+        return getattr(jax.nn, name)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.nn.functional' has no attribute '{name}'")

@@ -1,0 +1,245 @@
+"""Neural-network modules.
+
+The reference's ``ht.nn`` is a thin pass-through to ``torch.nn``
+(/root/reference/heat/nn/__init__.py:19-47): Heat supplies distribution
+(DataParallel), torch supplies the layers. On TPU the layer zoo is supplied
+by the JAX ecosystem instead; this module provides a minimal functional
+module system (params as pytrees, ``init``/``apply``) covering what the
+reference's examples exercise (examples/nn/mnist.py: Linear/Conv-free MLP
+paths, activations, dropout, losses), plus a ``flax.linen`` fallback in the
+package ``__getattr__`` mirroring the reference's delegation design.
+
+All modules are stateless: ``init(key)`` returns a parameter pytree,
+``apply(params, x, train=..., key=...)`` is a pure function — jit/grad/
+shard_map compose for free, which is the whole point of the TPU-first
+redesign (no backward hooks, no parameter mutation: reference
+data_parallel.py:120-124 registers per-parameter grad hooks precisely
+because torch mutates).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from typing import Any, Optional, Sequence, Tuple
+
+__all__ = [
+    "Module",
+    "Linear",
+    "ReLU",
+    "GELU",
+    "Tanh",
+    "Sigmoid",
+    "LogSoftmax",
+    "Softmax",
+    "Flatten",
+    "Dropout",
+    "Sequential",
+    "MSELoss",
+    "NLLLoss",
+    "CrossEntropyLoss",
+]
+
+
+class Module:
+    """Base class: stateless layer with ``init``/``apply``."""
+
+    def init(self, key: jax.Array):
+        """Return this module's parameter pytree ({} when parameter-free)."""
+        return {}
+
+    def apply(self, params, x, *, train: bool = False, key: Optional[jax.Array] = None):
+        raise NotImplementedError
+
+    def __call__(self, params, x, **kw):
+        return self.apply(params, x, **kw)
+
+
+class Linear(Module):
+    """Affine layer y = x W + b.
+
+    Parity with torch.nn.Linear (the reference MLP's building block) incl.
+    its Kaiming-uniform init; the weight is stored (in_features,
+    out_features) so the forward contraction feeds the MXU without a
+    transpose.
+    """
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 dtype=jnp.float32):
+        self.in_features = int(in_features)
+        self.out_features = int(out_features)
+        self.bias = bool(bias)
+        self.dtype = dtype
+
+    def init(self, key: jax.Array):
+        bound = 1.0 / math.sqrt(self.in_features)
+        wkey, bkey = jax.random.split(key)
+        params = {
+            "weight": jax.random.uniform(
+                wkey, (self.in_features, self.out_features), minval=-bound, maxval=bound,
+                dtype=self.dtype,
+            )
+        }
+        if self.bias:
+            params["bias"] = jax.random.uniform(
+                bkey, (self.out_features,), minval=-bound, maxval=bound, dtype=self.dtype
+            )
+        return params
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        y = x @ params["weight"]
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class _Activation(Module):
+    _fn = None
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        return type(self)._fn(x)
+
+
+class ReLU(_Activation):
+    _fn = staticmethod(jax.nn.relu)
+
+
+class GELU(_Activation):
+    _fn = staticmethod(jax.nn.gelu)
+
+
+class Tanh(_Activation):
+    _fn = staticmethod(jnp.tanh)
+
+
+class Sigmoid(_Activation):
+    _fn = staticmethod(jax.nn.sigmoid)
+
+
+class LogSoftmax(Module):
+    def __init__(self, dim: int = -1):
+        self.dim = dim
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        return jax.nn.log_softmax(x, axis=self.dim)
+
+
+class Softmax(Module):
+    def __init__(self, dim: int = -1):
+        self.dim = dim
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        return jax.nn.softmax(x, axis=self.dim)
+
+
+class Flatten(Module):
+    def __init__(self, start_dim: int = 1):
+        self.start_dim = start_dim
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        lead = x.shape[: self.start_dim]
+        return x.reshape(lead + (-1,))
+
+
+class Dropout(Module):
+    def __init__(self, p: float = 0.5):
+        if not 0.0 <= p < 1.0:
+            raise ValueError(f"dropout probability must be in [0, 1), got {p}")
+        self.p = float(p)
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        if not train or self.p == 0.0:
+            return x
+        if key is None:
+            raise ValueError("Dropout.apply(train=True) requires a PRNG key")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class Sequential(Module):
+    """Chain of modules; params is a tuple of per-module pytrees."""
+
+    def __init__(self, *modules: Module):
+        self.modules = tuple(modules)
+
+    def init(self, key: jax.Array):
+        keys = jax.random.split(key, max(len(self.modules), 1))
+        return tuple(m.init(k) for m, k in zip(self.modules, keys))
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        keys = (
+            jax.random.split(key, max(len(self.modules), 1))
+            if key is not None
+            else (None,) * len(self.modules)
+        )
+        for m, p, k in zip(self.modules, params, keys):
+            x = m.apply(p, x, train=train, key=k)
+        return x
+
+
+# --------------------------------------------------------------------- #
+# losses                                                                #
+# --------------------------------------------------------------------- #
+def scalar_dndarray(val, comm, device):
+    """Wrap a 0-d jax value as a replicated DNDarray (shared by losses and
+    the optimizer step returns)."""
+    from ..core.dndarray import DNDarray
+    from ..core import types
+
+    return DNDarray(
+        jax.device_put(val, comm.sharding(0, None)),
+        (),
+        types.canonical_heat_type(val.dtype),
+        None,
+        device,
+        comm,
+    )
+
+
+class _Loss:
+    """Callable loss; ``raw`` operates on jax arrays (used inside jitted
+    train steps), ``__call__`` accepts DNDarrays for API parity with the
+    reference's ``criterion(output, target)`` pattern."""
+
+    def raw(self, output, target, weight=None):
+        per = self._per_sample(output, target)
+        if weight is not None:
+            return jnp.sum(per * weight) / jnp.maximum(jnp.sum(weight), 1.0)
+        return jnp.mean(per)
+
+    def _per_sample(self, output, target):
+        raise NotImplementedError
+
+    def __call__(self, output, target):
+        from ..core.dndarray import DNDarray
+
+        if isinstance(output, DNDarray):
+            tgt_l = target.larray if isinstance(target, DNDarray) else target
+            val = self.raw(output.larray, tgt_l)
+            return scalar_dndarray(val, output.comm, output.device)
+        return self.raw(output, target)
+
+
+class MSELoss(_Loss):
+    def _per_sample(self, output, target):
+        d = (output - target.astype(output.dtype)) ** 2
+        return d.reshape(d.shape[0], -1).mean(axis=1) if d.ndim > 1 else d
+
+
+class NLLLoss(_Loss):
+    """Negative log likelihood over log-probabilities."""
+
+    def _per_sample(self, output, target):
+        return -jnp.take_along_axis(output, target[:, None].astype(jnp.int32), axis=1)[:, 0]
+
+
+class CrossEntropyLoss(_Loss):
+    """Softmax cross entropy over raw logits (torch semantics)."""
+
+    def _per_sample(self, output, target):
+        logp = jax.nn.log_softmax(output, axis=-1)
+        return -jnp.take_along_axis(logp, target[:, None].astype(jnp.int32), axis=1)[:, 0]

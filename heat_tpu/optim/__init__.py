@@ -1,0 +1,34 @@
+"""Optimizer layer of heat_tpu.
+
+Parity with /root/reference/heat/optim/__init__.py: ``DataParallelOptimizer``
+and ``DASO`` (dp_optimizer.py:851/:64), ``lr_scheduler`` and plateau
+utilities. Local optimizers (SGD/Adam/AdamW) are optax-backed; unknown
+attributes fall through to ``optax`` (the analog of the reference's
+torch.optim delegation).
+"""
+
+from .dp_optimizer import SGD, Adam, AdamW, DataParallelOptimizer, DASO, LocalOptimizer
+from .utils import DetectMetricPlateau
+from . import lr_scheduler
+from . import utils
+
+__all__ = [
+    "SGD",
+    "Adam",
+    "AdamW",
+    "LocalOptimizer",
+    "DataParallelOptimizer",
+    "DASO",
+    "DetectMetricPlateau",
+    "lr_scheduler",
+    "utils",
+]
+
+
+def __getattr__(name):
+    import optax as _optax
+
+    try:
+        return getattr(_optax, name)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.optim' has no attribute '{name}'")
