@@ -32,6 +32,7 @@ from . import nn
 from . import optim
 from . import preprocessing
 from . import regression
+from . import sparse
 from . import spatial
 from . import utils
 from .version import __version__

@@ -90,16 +90,23 @@ class DataParallel:
     # reference-API conveniences                                         #
     # ------------------------------------------------------------------ #
     def parameters(self):
-        """Flat iterator over parameter leaves (reference: torch
-        ``module.parameters()``)."""
-        return iter(jax.tree.leaves(self.params))
+        """Flat iterator over CURRENT parameter leaves (reference: torch
+        ``module.parameters()``) — under DASO training these are the
+        node-averaged weights, not the stale init."""
+        return iter(jax.tree.leaves(self._current_params()))
 
     def state_dict(self):
-        return self.params
+        """Current weights for checkpointing (under DASO: node-averaged)."""
+        return self._current_params()
 
     def load_state_dict(self, params):
         repl = self.comm.sharding(0, None)
         self.params = jax.tree.map(lambda p: jax.device_put(jnp.asarray(p), repl), params)
+        # an owning optimizer (DASO) must adopt the loaded weights, else its
+        # override would keep serving the pre-load replicas
+        owner = getattr(self, "_owner", None)
+        if owner is not None:
+            owner.load_params(self.params)
 
     def train(self):
         return self

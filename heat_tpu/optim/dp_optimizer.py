@@ -61,18 +61,21 @@ class SGD(LocalOptimizer):
                  nesterov: bool = False):
         import optax
 
-        def make(learning_rate, momentum, weight_decay, nesterov=nesterov):
-            parts = []
-            if weight_decay is not None:
-                parts.append(optax.add_decayed_weights(weight_decay))
-            parts.append(optax.sgd(learning_rate,
-                                   momentum=None if momentum is None else momentum,
-                                   nesterov=nesterov))
-            return optax.chain(*parts)
+        # momentum/weight_decay structure is decided statically so plain SGD
+        # carries no dead trace accumulator or no-op decay stage
+        mom = momentum if momentum else None
 
-        tx = optax.inject_hyperparams(make)(
-            learning_rate=lr, momentum=momentum, weight_decay=weight_decay
-        )
+        def sgd_part(learning_rate):
+            return optax.sgd(learning_rate, momentum=mom, nesterov=nesterov)
+
+        if weight_decay:
+            def make(learning_rate, weight_decay):
+                return optax.chain(optax.add_decayed_weights(weight_decay),
+                                   sgd_part(learning_rate))
+
+            tx = optax.inject_hyperparams(make)(learning_rate=lr, weight_decay=weight_decay)
+        else:
+            tx = optax.inject_hyperparams(sgd_part)(learning_rate=lr)
         super().__init__(tx, dict(lr=lr, momentum=momentum, weight_decay=weight_decay))
 
 
@@ -83,14 +86,17 @@ class Adam(LocalOptimizer):
 
         b1, b2 = betas
 
-        def make(learning_rate, weight_decay):
-            parts = []
-            if weight_decay is not None:
-                parts.append(optax.add_decayed_weights(weight_decay))
-            parts.append(optax.adam(learning_rate, b1=b1, b2=b2, eps=eps))
-            return optax.chain(*parts)
+        def adam_part(learning_rate):
+            return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
 
-        tx = optax.inject_hyperparams(make)(learning_rate=lr, weight_decay=weight_decay)
+        if weight_decay:
+            def make(learning_rate, weight_decay):
+                return optax.chain(optax.add_decayed_weights(weight_decay),
+                                   adam_part(learning_rate))
+
+            tx = optax.inject_hyperparams(make)(learning_rate=lr, weight_decay=weight_decay)
+        else:
+            tx = optax.inject_hyperparams(adam_part)(learning_rate=lr)
         super().__init__(tx, dict(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay))
 
 
@@ -264,6 +270,7 @@ class DASO:
         # model in place every step, so eval there is always current)
         self._eval_cache = (-1, None)
         model._param_override = self._eval_params
+        model._owner = self
 
     def _eval_params(self):
         it, cached = self._eval_cache
@@ -302,8 +309,10 @@ class DASO:
 
             def local_sums(pp):
                 out = module.apply(pp, xb, train=True, key=jax.random.fold_in(dropkey, dev))
-                per = loss._per_sample(out, yb)
-                return jnp.sum(per * w)
+                # documented loss contract: raw(output, target, weight) is the
+                # weighted MEAN; × Σw recovers the weighted sum this
+                # hierarchy reduces over
+                return loss.raw(out, yb, weight=w) * jnp.sum(w)
 
             sum_loss, g = jax.value_and_grad(local_sums)(p)
             wsum = jnp.sum(w)
@@ -359,6 +368,21 @@ class DASO:
 
     def zero_grad(self) -> None:
         """No-op (see DataParallelOptimizer.zero_grad)."""
+
+    def load_params(self, params) -> None:
+        """Adopt externally loaded weights (checkpoint restore): restack
+        them per node and reinitialize the optimizer state (momentum is not
+        part of the reference's checkpoint either, optim/utils.py:72)."""
+        node_sharded = NamedSharding(self.mesh, P("node"))
+        self.params = jax.tree.map(
+            lambda p: jax.device_put(
+                jnp.broadcast_to(jnp.asarray(p)[None], (self.n_nodes,) + jnp.asarray(p).shape),
+                node_sharded,
+            ),
+            params,
+        )
+        self.opt_state = jax.device_put(jax.vmap(self.tx.init)(self.params), node_sharded)
+        self._eval_cache = (-1, None)
 
     def sync_params(self) -> None:
         """Force a global parameter average and push the result into the

@@ -1,0 +1,22 @@
+"""Sparse layer of heat_tpu.
+
+Parity with /root/reference/heat/sparse/__init__.py: ``DCSR_matrix``,
+``sparse_csr_matrix``, ``sparse_add``/``sparse_mul``, ``to_dense``/
+``to_sparse``."""
+
+from .dcsr_matrix import DCSR_matrix
+from .factories import sparse_csr_matrix
+from .arithmetics import add, mul
+from .arithmetics import add as sparse_add, mul as sparse_mul
+from .manipulations import to_dense, to_sparse
+
+__all__ = [
+    "DCSR_matrix",
+    "sparse_csr_matrix",
+    "add",
+    "mul",
+    "sparse_add",
+    "sparse_mul",
+    "to_dense",
+    "to_sparse",
+]

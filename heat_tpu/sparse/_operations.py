@@ -1,0 +1,130 @@
+"""Elementwise binary machinery for DCSR matrices.
+
+Replaces /root/reference/heat/sparse/_operations.py (``__binary_op_csr`` at
+_operations.py:17, which calls torch's sparse CSR kernels per rank). The
+TPU formulation must be static-shape: the union/intersection pattern of two
+sparse operands is data-dependent, so the kernel works on a fixed
+``n1 + n2`` candidate set (pad-and-mask idiom) inside ONE jit:
+
+1. linearize both operands to keys ``row * ncols + col``;
+2. sort the concatenated candidates (each key appears at most twice, once
+   per operand — CSR patterns are duplicate-free);
+3. merge adjacent equal keys, summing each operand's contribution;
+4. combine (add → a + b, union pattern; mul → a * b, intersection);
+5. compact kept entries to the front with a cumsum scatter and rebuild the
+   indptr with a masked bincount.
+
+The result count reaches the host as one scalar; everything else stays on
+device. Sorting rides XLA's parallel sort — nnz-sharded inputs keep every
+device busy, unlike the reference's per-row-block kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = []
+
+
+@functools.lru_cache(maxsize=256)
+def _binary_csr_kernel(op_key: str, n1: int, n2: int, m: int, ncols: int, jdtype: str):
+    n = n1 + n2
+
+    @jax.jit
+    def kernel(cols1, data1, rows1, cols2, data2, rows2):
+        keys = jnp.concatenate([rows1 * ncols + cols1, rows2 * ncols + cols2])
+        a = jnp.concatenate([data1, jnp.zeros((n2,), dtype=data1.dtype)])
+        b = jnp.concatenate([jnp.zeros((n1,), dtype=data2.dtype), data2])
+        order = jnp.argsort(keys)
+        k = keys[order]
+        a = a[order]
+        b = b[order]
+        # duplicate keys are adjacent; fold the previous slot's contribution
+        # into the current one (each key appears at most twice)
+        dup = jnp.concatenate([jnp.zeros((1,), bool), k[1:] == k[:-1]])
+        a_m = a + jnp.where(dup, jnp.roll(a, 1), 0)
+        b_m = b + jnp.where(dup, jnp.roll(b, 1), 0)
+        if op_key == "add":
+            val = a_m + b_m
+            # union pattern: keep the LAST slot of each key group
+            keep = jnp.concatenate([k[1:] != k[:-1], jnp.ones((1,), bool)])
+        elif op_key == "mul":
+            val = a_m * b_m
+            # intersection pattern: keep only merged (both-present) slots
+            keep = dup
+        else:
+            raise ValueError(op_key)
+        count = jnp.sum(keep)
+        # stable compaction: kept entry i lands at position cumsum-1;
+        # dropped entries park out of range and are discarded by mode="drop"
+        pos = jnp.cumsum(keep) - 1
+        dest = jnp.where(keep, pos, n + jnp.arange(n))
+        out_keys = jnp.zeros((n,), dtype=k.dtype).at[dest].set(k, mode="drop")
+        out_vals = jnp.zeros((n,), dtype=val.dtype).at[dest].set(val, mode="drop")
+        valid = jnp.arange(n) < count
+        out_rows = jnp.where(valid, out_keys // ncols, 0)
+        out_cols = jnp.where(valid, out_keys % ncols, 0)
+        counts = jnp.zeros((m + 1,), dtype=jnp.int32).at[out_rows + 1].add(
+            valid.astype(jnp.int32)
+        )
+        indptr = jnp.cumsum(counts)
+        return indptr.astype(jnp.int32), out_cols.astype(jnp.int32), out_vals, count
+
+    return kernel
+
+
+def rows_from_indptr(indptr: jax.Array, nnz: int) -> jax.Array:
+    """COO row index per stored element, derived symbolically (static
+    shapes): rows[i] = searchsorted(indptr, i, 'right') - 1."""
+    return (
+        jnp.searchsorted(indptr, jnp.arange(nnz, dtype=indptr.dtype), side="right") - 1
+    ).astype(jnp.int32)
+
+
+def binary_op_csr(op_key: str, t1: DCSR_matrix, t2) -> DCSR_matrix:
+    """Elementwise binary op on two DCSR matrices (or matrix × scalar for
+    mul). Reference: _operations.py:17."""
+    if np.isscalar(t2) or isinstance(t2, (int, float)):
+        if op_key == "mul":
+            data = t1.data * jnp.asarray(t2, dtype=t1.data.dtype)
+            from .factories import _from_components
+
+            return _from_components(
+                t1.indptr, t1.indices, data, t1.shape, t1.split, t1.device, t1.comm
+            )
+        raise TypeError(
+            "sparse add with a scalar densifies the matrix; convert with to_dense first "
+            "(matches the reference's unsupported-op behavior)"
+        )
+    if not isinstance(t2, DCSR_matrix):
+        raise TypeError(f"expected DCSR_matrix or scalar, got {type(t2)}")
+    if t1.shape != t2.shape:
+        raise ValueError(f"shapes do not match: {t1.shape} vs {t2.shape}")
+
+    out_type = types.promote_types(t1.dtype, t2.dtype)
+    jdt = out_type.jax_type()
+    m, ncols = t1.shape
+    n1, n2 = t1.gnnz, t2.gnnz
+
+    rows1 = rows_from_indptr(t1.indptr, n1)
+    rows2 = rows_from_indptr(t2.indptr, n2)
+    kernel = _binary_csr_kernel(op_key, n1, n2, m, ncols, np.dtype(jdt).name)
+    indptr, cols_p, vals_p, count = kernel(
+        t1.indices, t1.data.astype(jdt), rows1, t2.indices, t2.data.astype(jdt), rows2
+    )
+    nnz = int(count)
+    from .factories import _from_components
+
+    return _from_components(
+        indptr, cols_p[:nnz], vals_p[:nnz], (m, ncols),
+        t1.split if t1.split is not None else t2.split,
+        t1.device, t1.comm,
+    )

@@ -1,0 +1,31 @@
+"""Elementwise arithmetic on DCSR matrices.
+
+Parity with /root/reference/heat/sparse/arithmetics.py (``add`` at :16,
+``mul`` at :54, exported into ``ht.sparse`` as ``sparse_add``/``sparse_mul``
+by the package __init__, plus the ``+``/``*`` dunders)."""
+
+from __future__ import annotations
+
+from . import _operations
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = ["add", "mul"]
+
+
+def add(t1: DCSR_matrix, t2) -> DCSR_matrix:
+    """Elementwise addition; result pattern is the union of both operands'
+    sparsity patterns (reference arithmetics.py:16)."""
+    return _operations.binary_op_csr("add", t1, t2)
+
+
+def mul(t1: DCSR_matrix, t2) -> DCSR_matrix:
+    """Elementwise (Hadamard) multiplication; result pattern is the
+    intersection (reference arithmetics.py:54). A scalar operand scales the
+    values in place of a pattern op."""
+    return _operations.binary_op_csr("mul", t1, t2)
+
+
+DCSR_matrix.__add__ = lambda self, other: add(self, other)
+DCSR_matrix.__radd__ = lambda self, other: add(self, other)
+DCSR_matrix.__mul__ = lambda self, other: mul(self, other)
+DCSR_matrix.__rmul__ = lambda self, other: mul(self, other)

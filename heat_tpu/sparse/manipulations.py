@@ -1,0 +1,75 @@
+"""Manipulations for DCSR matrices.
+
+Parity with /root/reference/heat/sparse/manipulations.py: ``to_dense``
+(:52) and ``to_sparse`` (:16), both attached to the array classes."""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from typing import Optional
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from .dcsr_matrix import DCSR_matrix
+from ._operations import rows_from_indptr
+from .factories import sparse_csr_matrix
+
+__all__ = ["to_dense", "to_sparse"]
+
+
+def to_sparse(array: DNDarray) -> DCSR_matrix:
+    """DNDarray → DCSR_matrix (reference manipulations.py:16). The sparsity
+    pattern is data-dependent, so extraction happens host-side at
+    construction time — the same eager boundary the reference crosses with
+    torch's ``to_sparse_csr``."""
+    if array.ndim != 2:
+        raise ValueError(f"to_sparse requires a 2-D DNDarray, got {array.ndim}-D")
+    split = 0 if array.split is not None else None
+    return sparse_csr_matrix(
+        array.numpy(), dtype=array.dtype, split=split, device=array.device, comm=array.comm
+    )
+
+
+DNDarray.to_sparse = to_sparse
+
+
+@functools.lru_cache(maxsize=128)
+def _scatter_dense(m: int, n: int, nnz: int, jdtype: str):
+    @jax.jit
+    def kernel(indptr, cols, data):
+        rows = rows_from_indptr(indptr, nnz)
+        return jnp.zeros((m, n), dtype=data.dtype).at[rows, cols].set(data)
+
+    return kernel
+
+
+def to_dense(sparse_matrix: DCSR_matrix, order: str = "C", out: Optional[DNDarray] = None) -> DNDarray:
+    """DCSR_matrix → dense DNDarray with the same distribution (reference
+    manipulations.py:52): one jitted scatter on device."""
+    if order not in ("C",):
+        raise NotImplementedError("XLA owns physical layout; only order='C' semantics exist")
+    m, n = sparse_matrix.shape
+    if sparse_matrix.gnnz == 0:
+        dense = jnp.zeros((m, n), dtype=sparse_matrix.dtype.jax_type())
+    else:
+        kernel = _scatter_dense(m, n, sparse_matrix.gnnz, np.dtype(sparse_matrix.dtype.jax_type()).name)
+        dense = kernel(sparse_matrix.indptr, sparse_matrix.indices, sparse_matrix.data)
+    comm = sparse_matrix.comm
+    result = DNDarray(
+        comm.shard(dense, sparse_matrix.split),
+        (m, n),
+        sparse_matrix.dtype,
+        sparse_matrix.split,
+        sparse_matrix.device,
+        comm,
+    )
+    if out is not None:
+        out._set_phys(result._phys)
+        return out
+    return result

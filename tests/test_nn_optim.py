@@ -138,6 +138,41 @@ class TestDASO:
             same = np.allclose(leaf[0], leaf[1], rtol=1e-6, atol=1e-7)
             assert same == (i % 3 == 0), f"iter {i}: node agreement {same}"
 
+    def test_daso_state_dict_and_load(self):
+        """Checkpoints during DASO training must capture trained weights,
+        and loading must redirect subsequent forwards."""
+        x_np, y_np = _toy_problem(n=256, seed=12)
+        x, y = ht.array(x_np, split=0), ht.array(y_np, split=0)
+        dp = htnn.DataParallel(_mlp(), key=9)
+        init_leaf = np.asarray(jax.tree.leaves(dp.params)[0]).copy()
+        daso = htoptim.DASO(htoptim.SGD(lr=0.1), dp, n_nodes=2, global_skip=2)
+        for _ in range(5):
+            daso.step(x, y)
+        ckpt = dp.state_dict()
+        trained_leaf = np.asarray(jax.tree.leaves(ckpt)[0])
+        assert not np.allclose(trained_leaf, init_leaf), "state_dict returned init weights"
+        out_before = dp(x).numpy()
+        for _ in range(5):
+            daso.step(x, y)
+        dp.load_state_dict(ckpt)
+        np.testing.assert_allclose(dp(x).numpy(), out_before, rtol=1e-5, atol=1e-6)
+
+    def test_daso_custom_loss_raw_contract(self):
+        """A loss implementing only the documented raw() API must work."""
+        class L2Loss:
+            def raw(self, output, target, weight=None):
+                per = jnp.sum((output - jax.nn.one_hot(target, output.shape[-1])) ** 2, axis=-1)
+                if weight is not None:
+                    return jnp.sum(per * weight) / jnp.maximum(jnp.sum(weight), 1.0)
+                return jnp.mean(per)
+
+        x_np, y_np = _toy_problem(n=128, seed=13)
+        dp = htnn.DataParallel(_mlp(), key=4)
+        daso = htoptim.DASO(htoptim.SGD(lr=0.05), dp, n_nodes=2, loss=L2Loss())
+        l0 = float(daso.step(ht.array(x_np, split=0), ht.array(y_np, split=0)))
+        l1 = float(daso.step(ht.array(x_np, split=0), ht.array(y_np, split=0)))
+        assert np.isfinite(l0) and np.isfinite(l1)
+
     def test_daso_lr_scheduler(self):
         dp = htnn.DataParallel(_mlp(), key=0)
         daso = htoptim.DASO(htoptim.SGD(lr=0.2), dp, n_nodes=2)
