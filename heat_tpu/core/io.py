@@ -61,6 +61,108 @@ def _from_numpy(data: np.ndarray, dtype, split, device, comm) -> DNDarray:
     return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
 
 
+def _assemble_sharded(read_slab, gshape, dtype, split, device, comm) -> DNDarray:
+    """Assemble a split DNDarray from per-device slab reads without ever
+    materializing the global array on the host — the single-controller
+    analog of the reference's per-rank hyperslab reads (io.py:57-150).
+
+    ``read_slab(slices) -> np.ndarray`` reads one hyperslab from storage.
+    Each device's (padded) block is read, zero-padded to the physical block
+    extent, put on ITS device only, and the global jax.Array is stitched
+    with ``make_array_from_single_device_arrays``.
+    """
+    from . import _padding
+    from .devices import sanitize_device
+
+    device = sanitize_device(device)
+    comm = sanitize_comm(comm)
+    gshape = tuple(int(s) for s in gshape)
+    split = sanitize_axis(gshape, split)
+    jdt = np.dtype(dtype.jax_type()) if dtype is not types.bfloat16 else np.float32
+
+    if jax.process_count() > 1:
+        # per-host ingest of only the addressable slabs lands with the
+        # multi-host runtime; fail loudly rather than device_put to a
+        # non-addressable device
+        raise NotImplementedError(
+            "multi-host hdf5 ingest lands with the multi-host runtime "
+            "(reference per-rank path: io.py:57)"
+        )
+
+    if split is None:
+        data = np.asarray(read_slab(tuple(slice(0, s) for s in gshape)), dtype=jdt)
+        return _from_numpy(data, dtype, None, device, comm)
+
+    phys = _padding.phys_shape(gshape, split, comm.size)
+    block = phys[split] // comm.size
+    n = gshape[split]
+    shards = []
+    blk_shape = list(gshape)
+    blk_shape[split] = block
+    for r, dev in enumerate(comm.devices):
+        start = r * block
+        stop = min(start + block, n)
+        if stop > start:
+            sl = tuple(
+                slice(start, stop) if i == split else slice(0, s) for i, s in enumerate(gshape)
+            )
+            slab = np.asarray(read_slab(sl), dtype=jdt)
+            if slab.shape[split] < block:
+                widths = [(0, 0)] * len(gshape)
+                widths[split] = (0, block - slab.shape[split])
+                slab = np.pad(slab, widths)
+        else:
+            slab = np.zeros(tuple(blk_shape), dtype=jdt)
+        if dtype is types.bfloat16:
+            slab = slab.astype(jnp.bfloat16)
+        shards.append(jax.device_put(slab, dev))
+    arr = jax.make_array_from_single_device_arrays(tuple(phys), comm.sharding(len(gshape), split), shards)
+    return DNDarray(arr, gshape, dtype, split, device, comm)
+
+
+def _write_shards(data: DNDarray, write_slab) -> None:
+    """Write a DNDarray shard-by-shard: ``write_slab(global_slices,
+    host_block)`` receives each device's LOGICAL block — the global array is
+    never gathered (the reference's rank-ordered writes, io.py:166-260)."""
+    if data.split is None:
+        arr = data._phys
+        if data.dtype is types.bfloat16:
+            arr = arr.astype(jnp.float32)
+        write_slab(tuple(slice(0, s) for s in data.shape), np.asarray(jax.device_get(arr)))
+        return
+    split = data.split
+    n = data.shape[split]
+    block = data._phys.shape[split] // data.comm.size
+    for r in range(data.comm.size):
+        start = r * block
+        stop = min(start + block, n)
+        if stop <= start:
+            continue
+        shard = None
+        for s in data._phys.addressable_shards:
+            # single-device/replicated shards carry slice(None) indices
+            s_start = s.index[split].start if s.index[split].start is not None else 0
+            if s_start == start:
+                shard = s.data
+                break
+        if shard is None:
+            if jax.process_count() == 1:
+                raise RuntimeError(
+                    f"no addressable shard found for block {r} (start {start}) — "
+                    f"shard indices: {[s.index for s in data._phys.addressable_shards]}"
+                )
+            continue  # non-addressable in multi-process; another host writes it
+        valid = [slice(None)] * data.ndim
+        valid[split] = slice(0, stop - start)
+        host = np.asarray(jax.device_get(shard[tuple(valid)]))
+        if data.dtype is types.bfloat16:
+            host = host.astype(np.float32)
+        sl = tuple(
+            slice(start, stop) if i == split else slice(0, s) for i, s in enumerate(data.shape)
+        )
+        write_slab(sl, host)
+
+
 if __HDF5:
     __all__.extend(["load_hdf5", "save_hdf5"])
 
@@ -85,27 +187,26 @@ if __HDF5:
         dtype = types.canonical_heat_type(dtype)
         with h5py.File(path, "r") as handle:
             ds = handle[dataset]
-            gshape = tuple(ds.shape)
+            gshape = list(ds.shape)
             if load_fraction < 1.0 and split is not None:
-                n = int(gshape[split] * load_fraction)
-                sl = [slice(None)] * len(gshape)
-                sl[split] = slice(0, n)
-                data = ds[tuple(sl)]
-            elif jax.process_count() > 1 and split is not None:
-                # per-host hyperslab read (the reference's per-rank chunk)
-                raise NotImplementedError("multi-host hdf5 ingest lands with the multi-host runtime")
-            else:
-                data = ds[...]
-        return _from_numpy(np.asarray(data), dtype, split, device, comm)
+                gshape[split] = int(gshape[split] * load_fraction)
+            return _assemble_sharded(
+                lambda sl: ds[sl], tuple(gshape), dtype, split, device, comm
+            )
 
     def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
-        """Save a DNDarray to HDF5 (reference: io.py:166)."""
+        """Save a DNDarray to HDF5 (reference: io.py:166). Writes one
+        hyperslab per device shard; the global array is never gathered."""
         if not isinstance(data, DNDarray):
             raise TypeError(f"data must be a DNDarray, got {type(data)}")
         if not isinstance(path, str):
             raise TypeError(f"path must be str, got {type(path)}")
+        np_dtype = (
+            np.float32 if data.dtype is types.bfloat16 else np.dtype(data.dtype.jax_type())
+        )
         with h5py.File(path, mode) as handle:
-            handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+            ds = handle.create_dataset(dataset, shape=data.shape, dtype=np_dtype, **kwargs)
+            _write_shards(data, lambda sl, host: ds.__setitem__(sl, host))
 
 
 if __NETCDF:

@@ -5,13 +5,18 @@ reference hand-implements a counter-based Threefry-2x32/64 cipher in torch
 ops (``__threefry32/64`` random.py:874/:976) precisely so that results are
 reproducible regardless of the number of MPI ranks (``__counter_sequence``
 :55-198 gives each rank its slice of the global 128-bit counter stream).
-JAX's native PRNG *is* counter-based Threefry — the design the reference
-emulates — so this module is a thin stateful wrapper over ``jax.random``:
-a global (seed, counter) pair advances per draw, giving the same
-sequence-stability guarantee for free, independent of mesh size.
+JAX's native PRNG *is* counter-based Threefry, and with
+``jax_threefry_partitionable`` (on by default) a draw jitted with sharded
+``out_shardings`` makes each device generate ONLY its slice of the counter
+stream — the exact design the reference emulates by hand. Draws here are
+therefore scale-safe (no device ever materializes the global array) and
+mesh-size independent (the same (seed, counter) produces the same global
+values on any mesh). A global (seed, counter) pair advances per draw.
 """
 
 from __future__ import annotations
+
+import functools
 
 import numpy as np
 
@@ -79,6 +84,65 @@ def _wrap(values: jax.Array, dtype, split, device, comm) -> DNDarray:
     return DNDarray(values, gshape, dtype, split, device, comm)
 
 
+@functools.lru_cache(maxsize=512)
+def _cached_sampler(mesh, axis_name: str, op_key: str, shape, jdtype: str, split):
+    """jit-compiled sampler with sharded output: partitionable Threefry
+    gives every device exactly its counter slice (the analog of the
+    reference's per-rank ``__counter_sequence``, random.py:55-198) — no
+    device materializes the full array. Distribution hyperparameters
+    (mean/std, low/high) are TRACED arguments, so an annealed std does not
+    recompile."""
+    from . import _padding
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    size = mesh.devices.size
+    if split is not None and (not shape or shape[split] == 0):
+        split = None
+    if split is None or not shape:
+        spec = PartitionSpec()
+    else:
+        spec = PartitionSpec(*(axis_name if i == split else None for i in range(len(shape))))
+    sharding = NamedSharding(mesh, spec)
+
+    def build(key, *args):
+        if op_key == "uniform":
+            logical = jax.random.uniform(key, shape, dtype=jdtype)
+        elif op_key == "normal":
+            mean, std = args
+            logical = jax.random.normal(key, shape, dtype=jdtype) * std + mean
+        elif op_key == "randint":
+            low, high = args
+            logical = jax.random.randint(key, shape, low, high, dtype=jdtype)
+        else:
+            raise ValueError(op_key)
+        return _padding.pad_logical(logical, split, size)
+
+    return jax.jit(build, out_shardings=sharding)
+
+
+def _draw(op_key: str, shape, dtype, split, device, comm, args=()) -> DNDarray:
+    device = sanitize_device(device)
+    comm = sanitize_comm(comm)
+    split = sanitize_axis(shape, split)
+    numel = int(np.prod(shape)) if shape else 1
+    key = _next_key(numel)
+    sampler = _cached_sampler(
+        comm.mesh,
+        comm.axis_name,
+        op_key,
+        tuple(shape),
+        np.dtype(dtype.jax_type()).name,
+        split,
+    )
+    if op_key == "normal":
+        args = (jnp.asarray(args[0], dtype=dtype.jax_type()),
+                jnp.asarray(args[1], dtype=dtype.jax_type()))
+    elif op_key == "randint":
+        args = (jnp.asarray(args[0]), jnp.asarray(args[1]))
+    data = sampler(key, *args)
+    return DNDarray(data, tuple(shape), dtype, split, device, comm)
+
+
 def get_state() -> Tuple[str, int, int, int, float]:
     """Return the internal state of the generator (reference:
     random.py get_state): ('Threefry', seed, counter, 0, 0.0)."""
@@ -125,13 +189,15 @@ def normal(
     dtype = types.canonical_heat_type(dtype)
     if dtype not in (types.float16, types.bfloat16, types.float32, types.float64):
         raise ValueError("dtype must be a float type")
-    numel = int(np.prod(shape)) if shape else 1
-    key = _next_key(numel)
-    base = jax.random.normal(key, shape, dtype=dtype.jax_type())
-    m = mean.larray if isinstance(mean, DNDarray) else mean
-    s = std.larray if isinstance(std, DNDarray) else std
-    values = base * s + m
-    return _wrap(values, dtype, split, device, comm)
+    if isinstance(mean, DNDarray) or isinstance(std, DNDarray):
+        # array-valued moments: draw standard normal sharded, scale eagerly
+        # (elementwise ops preserve the sharding; pad re-zeroed below)
+        base = _draw("normal", shape, dtype, split, device, comm, (0.0, 1.0))
+        m = mean.larray if isinstance(mean, DNDarray) else mean
+        s = std.larray if isinstance(std, DNDarray) else std
+        values = base.larray * s + m
+        return _wrap(values, dtype, base.split, base.device, base.comm)
+    return _draw("normal", shape, dtype, split, device, comm, (float(mean), float(std)))
 
 
 def permutation(x) -> DNDarray:
@@ -160,10 +226,7 @@ def rand(
     dtype = types.canonical_heat_type(dtype)
     if dtype not in (types.float16, types.bfloat16, types.float32, types.float64):
         raise ValueError(f"dtype must be a float type, got {dtype}")
-    numel = int(np.prod(shape)) if shape else 1
-    key = _next_key(numel)
-    values = jax.random.uniform(key, shape, dtype=dtype.jax_type())
-    return _wrap(values, dtype, split, device, comm)
+    return _draw("uniform", shape, dtype, split, device, comm)
 
 
 def randint(
@@ -186,10 +249,7 @@ def randint(
     dtype = types.canonical_heat_type(dtype if dtype is not None else types.int32)
     if dtype not in (types.int8, types.int16, types.int32, types.int64, types.uint8):
         raise ValueError(f"dtype must be an integer type, got {dtype}")
-    numel = int(np.prod(shape)) if shape else 1
-    key = _next_key(numel)
-    values = jax.random.randint(key, shape, low, high, dtype=dtype.jax_type())
-    return _wrap(values, dtype, split, device, comm)
+    return _draw("randint", shape, dtype, split, device, comm, (int(low), int(high)))
 
 
 random_integer = randint
@@ -208,10 +268,7 @@ def randn(
     dtype = types.canonical_heat_type(dtype)
     if dtype not in (types.float16, types.bfloat16, types.float32, types.float64):
         raise ValueError(f"dtype must be a float type, got {dtype}")
-    numel = int(np.prod(shape)) if shape else 1
-    key = _next_key(numel)
-    values = jax.random.normal(key, shape, dtype=dtype.jax_type())
-    return _wrap(values, dtype, split, device, comm)
+    return _draw("normal", shape, dtype, split, device, comm, (0.0, 1.0))
 
 
 def random(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:

@@ -37,10 +37,17 @@ __all__ = []
 @functools.lru_cache(maxsize=256)
 def _binary_csr_kernel(op_key: str, n1: int, n2: int, m: int, ncols: int, jdtype: str):
     n = n1 + n2
+    # linearized keys must not overflow: int64 once m*ncols exceeds int32
+    key_dt = jnp.int64 if m * ncols > np.iinfo(np.int32).max else jnp.int32
 
     @jax.jit
     def kernel(cols1, data1, rows1, cols2, data2, rows2):
-        keys = jnp.concatenate([rows1 * ncols + cols1, rows2 * ncols + cols2])
+        keys = jnp.concatenate(
+            [
+                rows1.astype(key_dt) * ncols + cols1.astype(key_dt),
+                rows2.astype(key_dt) * ncols + cols2.astype(key_dt),
+            ]
+        )
         a = jnp.concatenate([data1, jnp.zeros((n2,), dtype=data1.dtype)])
         b = jnp.concatenate([jnp.zeros((n1,), dtype=data2.dtype), data2])
         order = jnp.argsort(keys)
@@ -94,7 +101,11 @@ def binary_op_csr(op_key: str, t1: DCSR_matrix, t2) -> DCSR_matrix:
     mul). Reference: _operations.py:17."""
     if np.isscalar(t2) or isinstance(t2, (int, float)):
         if op_key == "mul":
-            data = t1.data * jnp.asarray(t2, dtype=t1.data.dtype)
+            # promote like dense arithmetic: int matrix x float scalar -> float
+            scalar_type = types.canonical_heat_type(type(t2))
+            out_type = types.promote_types(t1.dtype, scalar_type)
+            jdt = out_type.jax_type()
+            data = t1.data.astype(jdt) * jnp.asarray(t2, dtype=jdt)
             from .factories import _from_components
 
             return _from_components(

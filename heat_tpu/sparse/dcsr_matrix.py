@@ -84,7 +84,7 @@ class DCSR_matrix:
         self.__split = split
         self.__device = device
         self.__comm = comm
-        self.__balanced = True
+        self.__balanced = bool(balanced)
 
     # ------------------------------------------------------------------ #
     # global components                                                  #
@@ -157,7 +157,9 @@ class DCSR_matrix:
     # ------------------------------------------------------------------ #
     @property
     def balanced(self) -> bool:
-        return True
+        """Row distribution is chunk-canonical, so constructions mark True;
+        the stored flag is honored for reference-API parity."""
+        return self.__balanced
 
     @property
     def comm(self) -> Communication:

@@ -70,6 +70,10 @@ def to_dense(sparse_matrix: DCSR_matrix, order: str = "C", out: Optional[DNDarra
         comm,
     )
     if out is not None:
-        out._set_phys(result._phys)
+        if out.shape != result.shape:
+            raise ValueError(f"out has shape {out.shape}, expected {result.shape}")
+        if out.split != result.split:
+            raise ValueError(f"out has split {out.split}, expected {result.split}")
+        out._set_phys(result._phys.astype(out.dtype.jax_type()))
         return out
     return result

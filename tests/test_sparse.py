@@ -156,6 +156,27 @@ class TestArithmetics:
         np.testing.assert_allclose(np.asarray(out.data), a.data * 2.5, rtol=1e-6)
         np.testing.assert_array_equal(np.asarray(out.indptr), a.indptr)
 
+    def test_huge_column_space_no_key_overflow(self):
+        """Linearized keys must widen to int64 when m*ncols > 2^31."""
+        m, n = 3, 2**30
+        a = sp.csr_matrix(
+            (np.array([1.0, 2.0, 3.0], dtype=np.float32),
+             np.array([n - 1, 0, 5]),
+             np.array([0, 1, 2, 3])),
+            shape=(m, n),
+        )
+        sa = sparse_csr_matrix(a, split=0)
+        out = sparse_add(sa, sa)
+        np.testing.assert_array_equal(np.asarray(out.indices), [n - 1, 0, 5])
+        np.testing.assert_allclose(np.asarray(out.data), [2.0, 4.0, 6.0])
+
+    def test_mul_scalar_promotes_int_matrix(self):
+        a = sp.csr_matrix(np.array([[3, 0], [0, 4]], dtype=np.int32))
+        sa = sparse_csr_matrix(a, split=0)
+        out = sa * 2.5
+        assert out.dtype == ht.float32
+        np.testing.assert_allclose(np.asarray(out.data), [7.5, 10.0])
+
     def test_add_scalar_raises(self):
         sa = sparse_csr_matrix(_ref_matrix(), split=0)
         with pytest.raises(TypeError):
@@ -207,6 +228,13 @@ class TestManipulations:
         res = to_dense(s, out=out)
         assert res is out
         np.testing.assert_allclose(out.numpy(), ref.toarray(), rtol=1e-6)
+
+    def test_to_dense_out_mismatch_raises(self):
+        s = sparse_csr_matrix(_ref_matrix(seed=16), split=0)
+        with pytest.raises(ValueError):
+            to_dense(s, out=ht.zeros(s.shape, split=None))
+        with pytest.raises(ValueError):
+            to_dense(s, out=ht.zeros((s.shape[0] + 1, s.shape[1]), split=0))
 
     def test_repr_smoke(self):
         s = sparse_csr_matrix(_ref_matrix(m=3, n=3), split=0)
