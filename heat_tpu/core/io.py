@@ -204,6 +204,7 @@ if __HDF5:
         np_dtype = (
             np.float32 if data.dtype is types.bfloat16 else np.dtype(data.dtype.jax_type())
         )
+        np_dtype = kwargs.pop("dtype", np_dtype)  # h5py casts on write
         with h5py.File(path, mode) as handle:
             ds = handle.create_dataset(dataset, shape=data.shape, dtype=np_dtype, **kwargs)
             _write_shards(data, lambda sl, host: ds.__setitem__(sl, host))

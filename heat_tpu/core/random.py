@@ -67,10 +67,14 @@ def __init_seed() -> None:
 def _next_key(numel: int) -> jax.Array:
     """Fold the current counter into the seed key and advance the counter
     by the number of elements drawn (the reference's counter-slice logic,
-    random.py:55-198, without the per-rank arithmetic)."""
+    random.py:55-198, without the per-rank arithmetic). Both 32-bit words
+    of the counter are folded, so the stream only cycles after 2**64
+    elements — a mod-2**31 fold would silently repeat at large scale."""
     global __counter
     __init_seed()
-    key = jax.random.fold_in(jax.random.PRNGKey(__seed), __counter % (2**31))
+    key = jax.random.PRNGKey(__seed)
+    key = jax.random.fold_in(key, np.uint32(__counter & 0xFFFFFFFF))
+    key = jax.random.fold_in(key, np.uint32((__counter >> 32) & 0xFFFFFFFF))
     __counter += int(numel)
     return key
 

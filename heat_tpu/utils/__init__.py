@@ -1,3 +1,4 @@
 """Utilities (reference: /root/reference/heat/utils/)."""
 
 from . import data
+from . import vision_transforms

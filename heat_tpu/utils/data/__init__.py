@@ -2,4 +2,10 @@
 
 from . import matrixgallery
 from . import spherical
+from . import datatools
+from . import partial_dataset
+from . import mnist
 from .spherical import create_spherical_dataset
+from .datatools import DataLoader, Dataset, dataset_shuffle, dataset_ishuffle
+from .partial_dataset import PartialH5Dataset
+from .mnist import MNISTDataset

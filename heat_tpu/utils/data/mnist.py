@@ -1,0 +1,104 @@
+"""MNIST dataset over sharded arrays.
+
+Parity with /root/reference/heat/utils/data/mnist.py (``MNISTDataset`` at
+mnist.py:16, a split-aware torchvision MNIST). torchvision is not part of
+this stack (and the build environment has no network egress), so this
+reader parses the standard IDX files directly from a local directory —
+the same files torchvision's MNIST stores under ``<root>/MNIST/raw``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from typing import Optional
+
+from ...core import factories, types
+from ...core.dndarray import DNDarray
+from .datatools import Dataset
+
+__all__ = ["MNISTDataset"]
+
+_FILES = {
+    True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+    False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+}
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Parse an IDX file (optionally .gz): big-endian magic, dims, data."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def _find(root: str, name: str) -> str:
+    for cand in (
+        os.path.join(root, name),
+        os.path.join(root, name + ".gz"),
+        os.path.join(root, "MNIST", "raw", name),
+        os.path.join(root, "MNIST", "raw", name + ".gz"),
+    ):
+        if os.path.exists(cand):
+            return cand
+    raise FileNotFoundError(
+        f"MNIST file {name}(.gz) not found under {root} (expected the standard "
+        f"IDX layout, e.g. <root>/MNIST/raw/{name}); download is not possible "
+        f"in an egress-free environment"
+    )
+
+
+class MNISTDataset(Dataset):
+    """MNIST over the mesh (reference mnist.py:16).
+
+    Parameters
+    ----------
+    root : str
+        Directory containing the IDX files.
+    train : bool
+        Training split vs test split (reference: ``train``).
+    transform : callable, optional
+        Applied to the image array (host-side, once) — e.g.
+        ``heat_tpu.utils.vision_transforms.Normalize``.
+    ishuffle : bool
+        Async inter-epoch shuffling (reference mnist.py:122).
+    split : 0 or None
+        Sample-axis distribution (the reference always splits dim 0).
+    """
+
+    def __init__(
+        self,
+        root: str,
+        train: bool = True,
+        transform=None,
+        target_transform=None,
+        ishuffle: bool = False,
+        test_set: Optional[bool] = None,
+        split: Optional[int] = 0,
+    ):
+        if split not in (None, 0):
+            raise ValueError(f"MNISTDataset supports split 0 or None, got {split}")
+        img_name, lbl_name = _FILES[bool(train)]
+        images = _read_idx(_find(root, img_name)).astype(np.float32) / 255.0
+        labels = _read_idx(_find(root, lbl_name)).astype(np.int32)
+        if transform is not None:
+            images = np.asarray(transform(images))
+        if target_transform is not None:
+            labels = np.asarray(target_transform(labels))
+        data = factories.array(images, split=split)
+        targets = factories.array(labels, split=split)
+        super().__init__(
+            data,
+            targets=targets,
+            ishuffle=ishuffle,
+            test_set=(not train) if test_set is None else bool(test_set),
+        )
+        self.train = bool(train)

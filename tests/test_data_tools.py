@@ -1,0 +1,235 @@
+"""Data-tools tests: Dataset/DataLoader, global shuffle, PartialH5Dataset
+streaming, MNIST IDX reader, vision transforms. Mirrors the reference's
+utils/data usage (datatools feeding the DL training loop)."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import jax
+
+import heat_tpu as ht
+from heat_tpu import nn as htnn
+from heat_tpu import optim as htoptim
+from heat_tpu.utils.data import (
+    DataLoader,
+    Dataset,
+    MNISTDataset,
+    PartialH5Dataset,
+    dataset_shuffle,
+)
+from heat_tpu.utils import vision_transforms
+
+
+class TestDatasetDataLoader:
+    def _data(self, n=96, d=6):
+        x = ht.arange(n * d, dtype=ht.float32, split=0).reshape((n, d))
+        y = ht.arange(n, dtype=ht.int32, split=0)
+        return x, y
+
+    def test_batches_are_sharded_slices(self):
+        x, y = self._data()
+        loader = DataLoader(Dataset(x, targets=y), batch_size=32)
+        batches = list(loader)
+        assert len(batches) == 3 == len(loader)
+        xb, yb = batches[1]
+        assert xb.shape == (32, 6)
+        assert xb.split == 0
+        np.testing.assert_array_equal(yb.numpy(), np.arange(32, 64))
+
+    def test_drop_last(self):
+        x, _ = self._data(n=100)
+        assert len(DataLoader(Dataset(x), batch_size=32, drop_last=True)) == 3
+        loader = DataLoader(Dataset(x), batch_size=32, drop_last=False)
+        assert len(loader) == 4
+        assert list(loader)[-1].shape == (4, 6)
+
+    def test_shuffle_preserves_pairing_and_set(self):
+        x, y = self._data()
+        ds = Dataset(x, targets=y)
+        ht.random.seed(5)
+        ds.Shuffle()
+        xs, ys = ds.htdata.numpy(), ds.httargets.numpy()
+        assert not np.array_equal(ys, np.arange(96))  # actually permuted
+        assert set(ys.tolist()) == set(range(96))     # a permutation
+        # pairing intact: row i of x must still be the block of label y_i
+        np.testing.assert_array_equal(xs, (ys[:, None] * 6 + np.arange(6)).astype(np.float32))
+
+    def test_shuffle_uneven_keeps_pad_clean(self):
+        n = 101  # pads to 104 on 8 devices
+        x = ht.arange(n, dtype=ht.float32, split=0)
+        ds = Dataset(x)
+        ds.Shuffle()
+        phys = np.asarray(jax.device_get(ds.htdata._phys))
+        np.testing.assert_array_equal(phys[n:], 0.0)
+        assert set(ds.htdata.numpy().tolist()) == set(float(i) for i in range(n))
+
+    def test_shuffle_with_replicated_targets_uneven(self):
+        """Attrs with different splits (hence pad extents) must shuffle
+        with the same logical permutation."""
+        n = 101
+        x = ht.arange(n, dtype=ht.float32, split=0)
+        y = ht.arange(n, dtype=ht.int32, split=None)  # replicated: no pad
+        ds = Dataset(x, targets=y)
+        ht.random.seed(9)
+        ds.Shuffle()
+        np.testing.assert_array_equal(ds.htdata.numpy().astype(np.int32), ds.httargets.numpy())
+        assert set(ds.httargets.numpy().tolist()) == set(range(n))
+
+    def test_loader_shuffles_between_epochs(self):
+        x, y = self._data()
+        loader = DataLoader(Dataset(x, targets=y), batch_size=96, shuffle=True)
+        ht.random.seed(1)
+        (x1, y1) = next(iter(loader))
+        (x2, y2) = next(iter(loader))
+        assert not np.array_equal(y1.numpy(), y2.numpy())
+
+    def test_test_set_never_shuffles(self):
+        x, y = self._data()
+        loader = DataLoader(Dataset(x, targets=y, test_set=True), batch_size=96, shuffle=True)
+        (x1, y1) = next(iter(loader))
+        np.testing.assert_array_equal(y1.numpy(), np.arange(96))
+
+    def test_end_to_end_training(self):
+        """BASELINE config #5 shape: DataLoader feeding the DP optimizer."""
+        rng = np.random.default_rng(0)
+        w = rng.standard_normal((8, 3)).astype(np.float32)
+        x_np = rng.standard_normal((192, 8)).astype(np.float32)
+        y_np = np.argmax(x_np @ w, axis=1).astype(np.int32)
+        ds = Dataset(ht.array(x_np, split=0), targets=ht.array(y_np, split=0))
+        loader = DataLoader(ds, batch_size=48, shuffle=True)
+        dp = htnn.DataParallel(htnn.Sequential(htnn.Linear(8, 32), htnn.ReLU(), htnn.Linear(32, 3)), key=1)
+        opt = htoptim.DataParallelOptimizer(htoptim.Adam(lr=0.02), dp)
+        first = last = None
+        for epoch in range(15):
+            for xb, yb in loader:
+                loss = float(opt.step(xb, yb))
+                first = loss if first is None else first
+                last = loss
+        assert last < 0.5 * first, (first, last)
+
+
+class TestPartialH5:
+    @pytest.fixture
+    def h5file(self, tmp_path):
+        import h5py
+
+        path = os.path.join(str(tmp_path), "stream.h5")
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((1000, 4)).astype(np.float32)
+        labels = np.arange(1000, dtype=np.int32)
+        with h5py.File(path, "w") as f:
+            f.create_dataset("data", data=data)
+            f.create_dataset("labels", data=labels)
+        return path, data, labels
+
+    def test_streams_all_batches(self, h5file):
+        path, data, labels = h5file
+        ds = PartialH5Dataset(path, ["data", "labels"], batch_size=100, initial_load=256)
+        seen = []
+        for xb, yb in ds:
+            assert xb.shape == (100, 4)
+            assert xb.split == 0
+            seen.append(yb.numpy())
+        seen = np.concatenate(seen)
+        # chunk tails < batch are dropped (256 % 100 = 56 per chunk)
+        assert len(seen) == 800
+        assert len(np.unique(seen)) == len(seen)
+
+    def test_single_dataset_name(self, h5file):
+        path, data, _ = h5file
+        ds = PartialH5Dataset(path, "data", batch_size=250, initial_load=500)
+        batches = [b for b in ds]
+        assert len(batches) == 4
+        np.testing.assert_allclose(batches[0].numpy(), data[:250], rtol=1e-6)
+
+    def test_len_and_mismatched_datasets(self, tmp_path):
+        import h5py
+
+        path = os.path.join(str(tmp_path), "bad.h5")
+        with h5py.File(path, "w") as f:
+            f.create_dataset("a", data=np.zeros((10, 2)))
+            f.create_dataset("b", data=np.zeros((9,)))
+        with pytest.raises(ValueError):
+            PartialH5Dataset(path, ["a", "b"])
+
+    def test_dataloader_delegates(self, h5file):
+        path, _, _ = h5file
+        ds = PartialH5Dataset(path, "data", batch_size=500, initial_load=500)
+        loader = DataLoader(ds, batch_size=1)  # batch size owned by the stream
+        assert len(loader) == 2  # defers to the stream's own batching
+        assert len(list(loader)) == 2
+        with pytest.raises(ValueError):
+            DataLoader(ds, batch_size=1, shuffle=True)
+
+    def test_abandoned_iterator_releases_thread(self, h5file):
+        import threading
+        import time
+
+        path, _, _ = h5file
+        before = threading.active_count()
+        for _ in range(5):
+            it = iter(PartialH5Dataset(path, "data", batch_size=100, initial_load=128))
+            next(it)
+            it.close()
+        time.sleep(0.5)
+        assert threading.active_count() <= before + 1
+
+
+class TestMNIST:
+    @pytest.fixture
+    def mnist_root(self, tmp_path):
+        """Write tiny synthetic IDX files in the standard layout."""
+        root = str(tmp_path)
+        raw = os.path.join(root, "MNIST", "raw")
+        os.makedirs(raw)
+        rng = np.random.default_rng(0)
+        for prefix, n in (("train", 64), ("t10k", 32)):
+            images = rng.integers(0, 256, size=(n, 28, 28), dtype=np.uint8)
+            labels = rng.integers(0, 10, size=(n,), dtype=np.uint8)
+            with open(os.path.join(raw, f"{prefix}-images-idx3-ubyte"), "wb") as f:
+                f.write(struct.pack(">IIII", 0x00000803, n, 28, 28))
+                f.write(images.tobytes())
+            # gzip one of the files to exercise the .gz path
+            lbl_payload = struct.pack(">II", 0x00000801, n) + labels.tobytes()
+            with gzip.open(os.path.join(raw, f"{prefix}-labels-idx1-ubyte.gz"), "wb") as f:
+                f.write(lbl_payload)
+        return root
+
+    def test_loads_and_splits(self, mnist_root):
+        ds = MNISTDataset(mnist_root, train=True)
+        assert len(ds) == 64
+        assert ds.htdata.shape == (64, 28, 28)
+        assert ds.htdata.split == 0
+        assert ds.httargets.shape == (64,)
+        assert float(ht.max(ds.htdata)) <= 1.0
+        test = MNISTDataset(mnist_root, train=False)
+        assert len(test) == 32
+        assert test.test_set
+
+    def test_transform_applied(self, mnist_root):
+        tr = vision_transforms.Compose(
+            [vision_transforms.Normalize(0.5, 0.5)]
+        )
+        ds = MNISTDataset(mnist_root, train=True, transform=tr)
+        assert float(ht.min(ds.htdata)) < 0.0  # normalization shifted range
+
+    def test_missing_files_raise(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            MNISTDataset(str(tmp_path), train=True)
+
+
+class TestVisionTransforms:
+    def test_to_tensor_and_normalize(self):
+        img = np.full((4, 4), 255, dtype=np.uint8)
+        out = vision_transforms.ToTensor()(img)
+        np.testing.assert_allclose(out, 1.0)
+        norm = vision_transforms.Normalize(0.5, 0.5)(out)
+        np.testing.assert_allclose(norm, 1.0)
+
+    def test_unknown_transform_raises(self):
+        with pytest.raises(AttributeError):
+            vision_transforms.RandomCrop

@@ -67,6 +67,18 @@ class TestShardedRandom:
         n = ht.random.normal(mean, 0.01, (32,), split=0)
         assert abs(float(ht.mean(n)) - 3.0) < 0.1
 
+    def test_counter_no_wrap_at_2_31(self):
+        """The stream must not repeat after 2**31 drawn elements."""
+        ht.random.seed(1)
+        a = ht.random.rand(8)
+        ht.random.set_state(("Threefry", 1, 2**31, 0, 0.0))
+        b = ht.random.rand(8)
+        ht.random.set_state(("Threefry", 1, 2**33, 0, 0.0))
+        c = ht.random.rand(8)
+        assert not np.array_equal(a.numpy(), b.numpy())
+        assert not np.array_equal(a.numpy(), c.numpy())
+        assert not np.array_equal(b.numpy(), c.numpy())
+
     def test_counter_advances(self):
         ht.random.seed(0)
         a = ht.random.rand(16, split=0)
