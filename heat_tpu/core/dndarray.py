@@ -89,6 +89,7 @@ class DNDarray:
         self.__lshape_map = None
         self.__halo_next = None
         self.__halo_prev = None
+        self.__halos = None
         self.__partitions_dict__ = None
 
     # ------------------------------------------------------------------ #
@@ -266,7 +267,13 @@ class DNDarray:
 
     @property
     def array_with_halos(self) -> jax.Array:
-        """Local array with halos attached (reference dndarray.py:359)."""
+        """Physical array with per-shard halos attached (reference
+        dndarray.py:359: the rank-local tensor including halos). Runs ONE
+        jitted shard_map ``ppermute`` edge exchange (``parallel.
+        halo_exchange``); each device's block becomes
+        ``[prev-halo | block | next-halo]`` with zero outermost halos.
+        Requires a prior ``get_halo`` call; without one (or with
+        halo_size=0) returns the physical array unchanged."""
         return self.__cat_halo()
 
     @property
@@ -442,12 +449,15 @@ class DNDarray:
     def get_halo(self, halo_size: int, prev: bool = True, next: bool = True) -> None:
         """Fetch halos of size ``halo_size`` from neighboring shards along
         the split axis (reference dndarray.py:386: Isend/Irecv with the
-        prev/next populated rank). Stored per-device, stacked on a leading
-        device axis; consumed by ``array_with_halos``.
+        prev/next populated rank). Runs ONE jitted shard_map ``ppermute``
+        edge exchange over the mesh (``parallel.halo_exchange``) and caches
+        the halo'ed physical array for ``array_with_halos``; per-device
+        halo views are exposed through ``halo_prev``/``halo_next``.
 
-        On TPU the idiomatic form is a ``ppermute`` inside ``shard_map``;
-        eager API parity here slices the global array directly (the data
-        motion XLA emits is the same edge exchange).
+        Divergence from the reference: the exchange is between physically
+        adjacent shards (GSPMD blocks), so a fully-padded tail shard hands
+        its zero pad onward instead of being skipped — consumers of the
+        zero-pad invariant (e.g. ``signal.convolve``) are built for that.
         """
         if not isinstance(halo_size, int):
             raise TypeError(f"halo_size needs to be of Python type integer, {type(halo_size)} given")
@@ -456,32 +466,48 @@ class DNDarray:
         if not self.is_distributed() or halo_size == 0:
             self.__halo_prev = None
             self.__halo_next = None
+            self.__halos = None
             return
         split = self.__split
         populated = self.lshape_map[:, split]
         nonempty = [r for r in range(self.__comm.size) if populated[r] > 0]
         if len(nonempty) > 1 and halo_size > int(populated[np.array(nonempty)].min()):
             raise ValueError("halo_size exceeds the smallest local shard extent")
-        halo_prev: List[Optional[jax.Array]] = [None] * self.__comm.size
-        halo_next: List[Optional[jax.Array]] = [None] * self.__comm.size
-        for pos, r in enumerate(nonempty):
-            offset, lshape, _ = self.__comm.chunk(self.__gshape, split, rank=r)
-            if prev and pos > 0:
+
+        from . import parallel
+
+        hp = halo_size if prev else 0
+        hn = halo_size if next else 0
+        halod = parallel.halo_exchange(
+            self.__array, self.__comm.mesh, self.__comm.axis_name, split, hp, hn
+        )
+        self.__halos = (hp, hn, halod)
+
+        # per-device halo views (reference: the rank-local halo tensors)
+        size = self.__comm.size
+        ext = halod.shape[split] // size  # hp + block + hn
+        halo_prev: List[Optional[jax.Array]] = [None] * size
+        halo_next: List[Optional[jax.Array]] = [None] * size
+        for r in range(size):
+            base = r * ext
+            if hp and r > 0:
                 sl = [slice(None)] * self.ndim
-                sl[split] = slice(offset - halo_size, offset)
-                halo_prev[r] = self.larray[tuple(sl)]
-            if next and pos < len(nonempty) - 1:
-                end = offset + int(lshape[split])
+                sl[split] = slice(base, base + hp)
+                halo_prev[r] = halod[tuple(sl)]
+            if hn and r < size - 1:
                 sl = [slice(None)] * self.ndim
-                sl[split] = slice(end, end + halo_size)
-                halo_next[r] = self.larray[tuple(sl)]
+                sl[split] = slice(base + ext - hn, base + ext)
+                halo_next[r] = halod[tuple(sl)]
         self.__halo_prev = halo_prev
         self.__halo_next = halo_next
 
     def __cat_halo(self) -> jax.Array:
-        """Process-local array including halos (reference dndarray.py:359).
-        Single-controller: the global array already contains all halos."""
-        return self.__array
+        """Physical array with per-shard halos from the last ``get_halo``
+        (reference dndarray.py:359). Without one, the physical array."""
+        halos = getattr(self, "_DNDarray__halos", None)
+        if halos is None:
+            return self.__array
+        return halos[2]
 
     # ------------------------------------------------------------------ #
     # partition interface (reference dndarray.py:188/679)                #

@@ -9,6 +9,10 @@ same dataflow comes out of one sharded matmul-based distance expression:
 GSPMD partitions the (n × m) distance computation over the row shards and
 emits the rotating collectives on ICI; the quadratic-expansion form
 (‖x‖² + ‖y‖² − 2x·yᵀ) maps the inner product onto the MXU.
+
+Both schedules are available: the default lets GSPMD choose; ``ring=True``
+runs the explicit ``ppermute`` ring program (``core.parallel.ring_pairwise``),
+including the reference's symmetry-skipping half-ring when X ≡ Y.
 """
 
 from __future__ import annotations
@@ -62,9 +66,46 @@ def _wrap(result: jax.Array, X: DNDarray, Y: Optional[DNDarray], dtype) -> DNDar
     return DNDarray(result, gshape, dtype, split, X.device, X.comm)
 
 
-def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool = False) -> DNDarray:
-    """Pairwise Euclidean distances (reference: distance.py:135)."""
+def _ring_path(X: DNDarray, Y: Optional[DNDarray], metric: str, dtype) -> Optional[DNDarray]:
+    """Explicit ppermute-ring schedule (reference distance.py:208-477) —
+    usable when both operands are split along axis 0. X ≡ Y (Y=None) runs
+    the symmetry-skipping half ring. Returns None when the layout does not
+    admit the ring (caller falls back to GSPMD)."""
+    from ..core import parallel
+
+    comm = X.comm
+    if comm.size <= 1 or X.split != 0 or (Y is not None and Y.split != 0):
+        return None
+    jt = dtype.jax_type()
+    x_phys = X._phys.astype(jt)
+    y_phys = x_phys if Y is None else Y._phys.astype(jt)
+    out = parallel.ring_pairwise(
+        x_phys, y_phys, comm.mesh, comm.axis_name, metric=metric, symmetric=Y is None
+    )
+    n_y = X.shape[0] if Y is None else Y.shape[0]
+    gshape = (X.shape[0], n_y)
+    logical = out[: gshape[0], : gshape[1]]
+    phys = comm.shard(logical, 0)
+    return DNDarray(phys, gshape, dtype, 0, X.device, comm)
+
+
+def cdist(
+    X: DNDarray,
+    Y: Optional[DNDarray] = None,
+    quadratic_expansion: bool = False,
+    ring: bool = False,
+) -> DNDarray:
+    """Pairwise Euclidean distances (reference: distance.py:135).
+
+    ``ring=True`` selects the explicit ppermute-ring schedule (half ring
+    with symmetric fill when ``Y is None``) instead of GSPMD's derived
+    collectives; results are identical."""
     x, y, dtype = _prepare(X, Y)
+    if ring:
+        metric = "euclidean" if quadratic_expansion else "euclidean_direct"
+        out = _ring_path(X, Y, metric, dtype)
+        if out is not None:
+            return out
     if quadratic_expansion:
         # MXU form: ‖x‖² + ‖y‖² − 2 x·yᵀ
         x2 = jnp.sum(x * x, axis=1, keepdims=True)
@@ -77,9 +118,15 @@ def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool =
     return _wrap(result, X, Y, dtype)
 
 
-def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False) -> DNDarray:
+def manhattan(
+    X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False, ring: bool = False
+) -> DNDarray:
     """Pairwise L1 distances (reference: distance.py:185)."""
     x, y, dtype = _prepare(X, Y)
+    if ring:
+        out = _ring_path(X, Y, "manhattan", dtype)
+        if out is not None:
+            return out
     diff = jnp.abs(x[:, None, :] - y[None, :, :])
     result = jnp.sum(diff, axis=-1)
     return _wrap(result, X, Y, dtype)
@@ -90,9 +137,25 @@ def rbf(
     Y: Optional[DNDarray] = None,
     sigma: float = 1.0,
     quadratic_expansion: bool = False,
+    ring: bool = False,
 ) -> DNDarray:
     """RBF kernel exp(−d²/(2σ²)) (reference: distance.py:158)."""
     x, y, dtype = _prepare(X, Y)
+    if ring:
+        metric = "sqeuclidean" if quadratic_expansion else "sqeuclidean_direct"
+        d2_arr = _ring_path(X, Y, metric, dtype)
+        if d2_arr is not None:
+            from ..core import _padding
+
+            scale = -1.0 / (2.0 * sigma * sigma)
+            # exp(0)=1 would poison the pad region — restore the zero-pad
+            # invariant (_padding docstring) before wrapping
+            vals = _padding.mask_phys(
+                jnp.exp(d2_arr._phys * scale), d2_arr.gshape, d2_arr.split
+            )
+            return DNDarray(
+                vals, d2_arr.gshape, d2_arr.dtype, d2_arr.split, d2_arr.device, d2_arr.comm
+            )
     if quadratic_expansion:
         x2 = jnp.sum(x * x, axis=1, keepdims=True)
         y2 = jnp.sum(y * y, axis=1, keepdims=True).T
