@@ -158,7 +158,7 @@ class DNDarray:
         if self.__split is not None and self.__split >= len(self.__gshape):
             self.__split = None
         self.__array = self.__comm.shard(array, self.__split)
-        self.__lshape_map = None
+        self._invalidate_caches()
 
     @property
     def _phys(self) -> jax.Array:
@@ -171,7 +171,16 @@ class DNDarray:
         pad region must be zero)."""
         self.__array = array
         self.__dtype = types.canonical_heat_type(array.dtype)
+        self._invalidate_caches()
+
+    def _invalidate_caches(self) -> None:
+        """Drop caches derived from the physical array (lshape map, halo
+        arrays) — must run on every rebind of the underlying buffer, else
+        ``array_with_halos``/``halo_prev``/``halo_next`` serve stale data."""
         self.__lshape_map = None
+        self.__halos = None
+        self.__halo_prev = None
+        self.__halo_next = None
 
     @property
     def nbytes(self) -> int:
@@ -294,6 +303,7 @@ class DNDarray:
         if not copy:
             self.__array = casted
             self.__dtype = dtype
+            self._invalidate_caches()
             return self
         return DNDarray(casted, self.__gshape, dtype, self.__split, self.__device, self.__comm)
 
@@ -389,7 +399,7 @@ class DNDarray:
             return self
         self.__array = self.__comm.reshard_phys(self.__array, self.__gshape, self.__split, axis)
         self.__split = axis
-        self.__lshape_map = None
+        self._invalidate_caches()
         return self
 
     def resplit(self, axis: Optional[int] = None) -> "DNDarray":
@@ -431,7 +441,7 @@ class DNDarray:
         logical = _padding.unpad(self.__array, self.__gshape, self.__split)
         self.__array = jax.device_put(logical, jax.sharding.SingleDeviceSharding(device))
         self.__split = None
-        self.__lshape_map = None
+        self._invalidate_caches()
 
     def fill_diagonal(self, value) -> "DNDarray":
         """Fill the main diagonal (reference dndarray.py:~600)."""
@@ -441,6 +451,7 @@ class DNDarray:
         idx = jnp.arange(n)
         new = self.larray.at[idx, idx].set(jnp.asarray(value, dtype=self.__array.dtype))
         self.__array = self.__comm.shard(new, self.__split)
+        self._invalidate_caches()
         return self
 
     # ------------------------------------------------------------------ #
@@ -504,10 +515,9 @@ class DNDarray:
     def __cat_halo(self) -> jax.Array:
         """Physical array with per-shard halos from the last ``get_halo``
         (reference dndarray.py:359). Without one, the physical array."""
-        halos = getattr(self, "_DNDarray__halos", None)
-        if halos is None:
+        if self.__halos is None:
             return self.__array
-        return halos[2]
+        return self.__halos[2]
 
     # ------------------------------------------------------------------ #
     # partition interface (reference dndarray.py:188/679)                #
@@ -653,6 +663,7 @@ class DNDarray:
         functional update ``at[key].set`` under the original sharding."""
         if isinstance(key, LocalIndex):
             self.__array = self.__array.at[key.obj].set(jnp.asarray(value))
+            self._invalidate_caches()
             return
         if isinstance(key, DNDarray):
             key = key.larray
@@ -663,6 +674,7 @@ class DNDarray:
         value = jnp.asarray(value, dtype=self.__dtype.jax_type()) if not isinstance(value, jax.Array) else value.astype(self.__dtype.jax_type())
         new = self.larray.at[key].set(value)
         self.__array = self.__comm.shard(new, self.__split)
+        self._invalidate_caches()
 
     # ------------------------------------------------------------------ #
     # misc protocol                                                      #

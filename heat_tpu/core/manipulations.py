@@ -8,9 +8,10 @@ the comm-heaviest module of the reference with 26 collective call-sites:
 exchange, ``unique`` at :3202, ``topk`` at :3981, ``roll`` at :2156,
 ``pad`` at :1328). Here each op computes on the logical global array and
 re-establishes the output sharding; XLA emits the data movement (the
-all-to-all a reshape-with-new-split needs, the gather a sort needs) over
-ICI. ``sort`` on TPU is XLA's bitonic/stable sort rather than a hand-rolled
-sample-sort — the MXU-era replacement for the same algorithmic job.
+all-to-all a reshape-with-new-split needs) over ICI. ``sort`` along the
+split axis runs ``core.parallel.distributed_sort`` — an odd-even block
+merge-split network of ``ppermute`` exchanges (gather-free); off-split
+sorts are lane-local XLA sorts.
 """
 
 from __future__ import annotations
@@ -404,20 +405,85 @@ def shape(a: DNDarray) -> Tuple[int, ...]:
     return a.gshape
 
 
+def _takes_distributed_sort(a: DNDarray, axis: int) -> bool:
+    return (
+        a.split is not None
+        and axis == a.split
+        and a.comm.size > 1
+        and a.dtype not in (types.complex64, types.complex128)
+    )
+
+
+def _sort_sentinel_fill(a: DNDarray, axis: int) -> jax.Array:
+    """Physical array with pad rows set to the dtype's maximal sentinel so
+    they sink to the global tail (= canonical pad location) during a
+    distributed sort. NaN sorts after +inf in XLA's total order; real NaNs
+    stay ahead of pads (position tie-break / stable order)."""
+    from . import _padding
+
+    phys = a._phys
+    if phys.shape[axis] == a.gshape[axis]:
+        return phys
+    jt = a.dtype.jax_type()
+    if jnp.issubdtype(jt, jnp.floating):
+        sentinel = jnp.nan
+    elif jnp.issubdtype(jt, jnp.bool_):
+        sentinel = True
+    else:
+        sentinel = jnp.iinfo(jt).max
+    return _padding.mask_phys(phys, a.gshape, axis, fill=sentinel)
+
+
+def _sorted_values(a: DNDarray, axis: int):
+    """Gather-free sorted VALUES along the split axis, or None when the
+    layout doesn't admit it. Runs the half-traffic values-only program
+    (no index operand in the ppermutes) — the percentile/median hot path."""
+    if not _takes_distributed_sort(a, axis):
+        return None
+    from . import _padding
+    from . import parallel
+
+    phys = _sort_sentinel_fill(a, axis)
+    sv = parallel.distributed_sort(
+        phys, a.comm.mesh, a.comm.axis_name, axis, with_indices=False
+    )
+    sv = _padding.mask_phys(sv, a.gshape, axis, 0)
+    return DNDarray(sv, a.gshape, a.dtype, axis, a.device, a.comm)
+
+
 def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     """Sort along an axis; returns (values, indices) (reference:
-    manipulations.py:2428 — distributed sample-sort with Alltoallv; here
-    XLA's sort on the sharded array — same O(n log n) job, MXU-era codegen).
+    manipulations.py:2428 — distributed sample-sort with Alltoallv).
+
+    When the sort axis IS the split axis and the mesh has >1 device, this
+    runs ``parallel.distributed_sort`` — an odd-even block merge-split
+    network of ``ppermute`` exchanges that never gathers the array (the
+    explicit-SPMD replacement for the reference's Alltoallv sample-sort).
+    Otherwise (non-split axis: every lane is shard-local) XLA's sort on
+    the sharded array is already collective-free.
     """
     sanitize_in(a)
     axis = sanitize_axis(a.shape, axis)
     if axis is None:
         axis = a.ndim - 1
-    arr = a.larray
-    indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
-    values = jnp.take_along_axis(arr, indices, axis=axis)
-    vals = _wrap(values, a.split, a, dtype=a.dtype)
-    idx = _wrap(indices.astype(jnp.int64), a.split, a)
+    if _takes_distributed_sort(a, axis):
+        from . import _padding
+        from . import parallel
+
+        phys = _sort_sentinel_fill(a, axis)
+        sv, si = parallel.distributed_sort(phys, a.comm.mesh, a.comm.axis_name, axis)
+        sv = _padding.mask_phys(sv, a.gshape, axis, 0)
+        si = _padding.mask_phys(si.astype(jnp.int64), a.gshape, axis, 0)
+        vals = DNDarray(sv, a.gshape, a.dtype, axis, a.device, a.comm)
+        idx = DNDarray(si, a.gshape, types.canonical_heat_type(si.dtype), axis, a.device, a.comm)
+        if descending:
+            vals, idx = flip(vals, axis), flip(idx, axis)
+    else:
+        arr = a.larray
+        indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
+        values = jnp.take_along_axis(arr, indices, axis=axis)
+        vals = _wrap(values, a.split, a, dtype=a.dtype)
+        idx = _wrap(indices.astype(jnp.int64), a.split, a)
     if out is not None:
         out.larray = vals.larray
         return out, idx

@@ -173,6 +173,137 @@ _METRICS = {
 }
 
 
+# ---------------------------------------------------------------------- #
+# distributed sort                                                       #
+# ---------------------------------------------------------------------- #
+@functools.lru_cache(maxsize=64)
+def _oddeven_sort_values_program(mesh: Mesh, axis_name: str, ndim: int, split: int):
+    """Values-only variant of the odd-even sort: no index operand rides the
+    ``ppermute``s, halving per-round collective volume (the hot
+    percentile/median path needs only sorted values). Tie consistency
+    between partners comes from concatenating in GLOBAL RANK ORDER on both
+    sides (lower-ranked partner's block first) + a stable sort — both
+    partners then order the identical sequence identically."""
+    p = mesh.devices.size
+    spec = P(*(axis_name if i == split else None for i in range(ndim)))
+
+    def body(v):
+        r = lax.axis_index(axis_name)
+        B = v.shape[split]
+        (v,) = lax.sort((v,), dimension=split, is_stable=True)
+        for t in range(p):
+            start = t % 2
+            pairs = [(a, a + 1) for a in range(start, p - 1, 2)]
+            if not pairs:
+                continue
+            perm = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
+            pv = lax.ppermute(v, axis_name, perm)
+            last = pairs[-1][1]
+            in_pair = (r >= start) & (r <= last)
+            is_low = in_pair & (((r - start) % 2) == 0)
+            a_blk = jnp.where(is_low, v, pv)
+            b_blk = jnp.where(is_low, pv, v)
+            (mv,) = lax.sort(
+                (jnp.concatenate([a_blk, b_blk], axis=split),),
+                dimension=split,
+                is_stable=True,
+            )
+            lo = lax.slice_in_dim(mv, 0, B, axis=split)
+            hi = lax.slice_in_dim(mv, B, 2 * B, axis=split)
+            v = jnp.where(in_pair, jnp.where(is_low, lo, hi), v)
+        return v
+
+    fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _oddeven_sort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx_dtype: str):
+    """shard_map odd-even block merge-split sort along ``split``.
+
+    The reference's distributed sort (manipulations.py:2428) is a
+    sample-sort: local sort, splitter election, Alltoallv partition
+    exchange. Alltoallv's variable counts are the wrong shape for XLA —
+    bucket sizes are data-dependent. The TPU-native formulation is the
+    odd-even block merge-split network (Baudet–Stevenson): after one local
+    sort, ``p`` rounds of a STATIC neighbor pattern where paired shards
+    exchange blocks over ICI (``ppermute``), jointly sort the 2B rows, and
+    keep the low/high half. Every shape is static, every round compiles to
+    one collective-permute + one fused local sort, and the network is
+    provably sorted after ``p`` rounds for any input.
+
+    Ties are broken by the global position index carried as a second sort
+    key, so both partners compute the *same* total order of their union —
+    without this, equal keys could be duplicated or dropped at the block
+    boundary (the two partners concatenate in different orders).
+
+    Returns (values, indices): indices are the pre-sort global positions
+    along ``split`` (argsort semantics). Other dims are batch lanes.
+    """
+    p = mesh.devices.size
+    spec = P(*(axis_name if i == split else None for i in range(ndim)))
+    idt = jnp.dtype(idx_dtype)
+
+    def body(v):
+        r = lax.axis_index(axis_name)
+        B = v.shape[split]
+        # global position of every local row along the split axis
+        i = lax.broadcasted_iota(idt, v.shape, split) + (r * B).astype(idt)
+        v, i = lax.sort((v, i), dimension=split, num_keys=2)
+        for t in range(p):
+            start = t % 2
+            pairs = [(a, a + 1) for a in range(start, p - 1, 2)]
+            if not pairs:
+                continue
+            perm = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
+            pv = lax.ppermute(v, axis_name, perm)
+            pi = lax.ppermute(i, axis_name, perm)
+            mv, mi = lax.sort(
+                (jnp.concatenate([v, pv], axis=split), jnp.concatenate([i, pi], axis=split)),
+                dimension=split,
+                num_keys=2,
+            )
+            lo_v = lax.slice_in_dim(mv, 0, B, axis=split)
+            hi_v = lax.slice_in_dim(mv, B, 2 * B, axis=split)
+            lo_i = lax.slice_in_dim(mi, 0, B, axis=split)
+            hi_i = lax.slice_in_dim(mi, B, 2 * B, axis=split)
+            last = pairs[-1][1]
+            in_pair = (r >= start) & (r <= last)
+            is_low = in_pair & (((r - start) % 2) == 0)
+            v = jnp.where(in_pair, jnp.where(is_low, lo_v, hi_v), v)
+            i = jnp.where(in_pair, jnp.where(is_low, lo_i, hi_i), i)
+        return v, i
+
+    fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=(spec, spec))
+    return jax.jit(fn)
+
+
+def distributed_sort(
+    phys: jax.Array,
+    mesh: Mesh,
+    axis_name: str,
+    split: int,
+    with_indices: bool = True,
+):
+    """Ascending sort of the physical array ``phys`` along its sharded
+    axis ``split`` without gathering — the explicit-SPMD replacement for
+    the reference's sample-sort + Alltoallv (manipulations.py:2428).
+
+    The caller owns pad semantics: pad rows must already hold a
+    maximal sentinel (NaN for floats, type-max for ints) so they sink to
+    the global tail — the canonical pad location. Returns physical
+    (values, indices), indices being pre-sort global positions (pads get
+    positions ≥ the logical extent, so callers can re-zero them); with
+    ``with_indices=False``, returns only values via a program whose
+    collectives carry half the volume.
+    """
+    if not with_indices:
+        return _oddeven_sort_values_program(mesh, axis_name, phys.ndim, split)(phys)
+    idx_dtype = "int32" if phys.shape[split] < 2**31 else "int64"
+    prog = _oddeven_sort_program(mesh, axis_name, phys.ndim, split, idx_dtype)
+    return prog(phys)
+
+
 def ring_pairwise(
     x_phys: jax.Array,
     y_phys: jax.Array,

@@ -84,9 +84,12 @@ def convolve(a: DNDarray, v: DNDarray, mode: str = "full") -> DNDarray:
     ker = v.larray.astype(compute.jax_type())
 
     # zero-extension turning every mode into sliding valid windows:
-    # out[g] = sum_s a_ext[g+s] * v[k-1-s]
-    left = {"full": k - 1, "same": (k - 1) // 2, "valid": 0}[mode]
-    right = {"full": k - 1, "same": k - 1 - (k - 1) // 2, "valid": 0}[mode]
+    # out[g] = sum_s a_ext[g+s] * v[k-1-s] = full[g + (k-1) - left], so
+    # 'same' needs left = k - 1 - (k-1)//2 = k//2 — the operand swap above
+    # can make k even even though even *kernels* were rejected pre-swap
+    # (reference signal.py:195 handles the post-swap even case the same way)
+    left = {"full": k - 1, "same": k // 2, "valid": 0}[mode]
+    right = {"full": k - 1, "same": k - 1 - k // 2, "valid": 0}[mode]
     out_len = n + left + right - (k - 1)
 
     comm = a.comm

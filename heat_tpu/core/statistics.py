@@ -345,18 +345,79 @@ def percentile(
     keepdims: bool = False,
 ) -> DNDarray:
     """q-th percentile (reference: statistics.py:1407 — distributed sort +
-    halo + Allgather of index maps; here XLA's sort/quantile on the sharded
-    array)."""
+    halo + Allgather of index maps).
+
+    When the reduction axis is the split axis, this runs the gather-free
+    ``ht.sort`` (odd-even ppermute network, ``core.parallel``) and then
+    fetches only the two bracketing ranks per q — the TPU analog of the
+    reference's sorted-halo rank lookup. Other axes use XLA's lane-local
+    percentile on the sharded array."""
     sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
     if interpolation not in ("linear", "lower", "higher", "midpoint", "nearest"):
         raise ValueError(f"unknown interpolation {interpolation}")
     q_arr = q.larray if isinstance(q, DNDarray) else jnp.asarray(np.asarray(q, dtype=np.float64))
     scalar_q = q_arr.ndim == 0
-    arr = x.larray
-    if types.heat_type_is_exact(x.dtype):
-        arr = arr.astype(jnp.float32)
-    result = jnp.percentile(arr, q_arr, axis=axis, method=interpolation, keepdims=keepdims)
+    qv = np.atleast_1d(np.asarray(q_arr, dtype=np.float64))
+    if np.any(qv < 0.0) or np.any(qv > 100.0):
+        raise ValueError("percentiles must be in the range [0, 100]")
+    eff_axis = axis
+    if eff_axis is None and x.ndim == 1:
+        eff_axis = 0
+    sorted_x = None
+    if (
+        eff_axis is not None
+        and x.split == eff_axis
+        and x.comm.size > 1
+        and x.dtype not in (types.complex64, types.complex128)
+    ):
+        from . import manipulations
+
+        sorted_x = manipulations._sorted_values(x, eff_axis)
+    if sorted_x is not None:
+        sarr = sorted_x.larray
+        if types.heat_type_is_exact(x.dtype):
+            sarr = sarr.astype(jnp.float32)
+        n = x.gshape[eff_axis]
+        pos = qv / 100.0 * (n - 1)
+        lo = np.floor(pos).astype(np.int64)
+        hi = np.ceil(pos).astype(np.int64)
+        # ranks are host-static: only two cross-shard row fetches per q
+        vlo = jnp.take(sarr, jnp.asarray(lo), axis=eff_axis)
+        vhi = jnp.take(sarr, jnp.asarray(hi), axis=eff_axis)
+        if interpolation == "lower":
+            res = vlo
+        elif interpolation == "higher":
+            res = vhi
+        elif interpolation == "midpoint":
+            res = (vlo + vhi) / 2
+        elif interpolation == "nearest":
+            nearest = np.rint(pos).astype(np.int64)
+            res = jnp.take(sarr, jnp.asarray(nearest), axis=eff_axis)
+        else:  # linear
+            frac = jnp.asarray(pos - lo, dtype=sarr.dtype)
+            fshape = [1] * sarr.ndim
+            fshape[eff_axis] = len(qv)
+            res = vlo + frac.reshape(fshape) * (vhi - vlo)
+        if jnp.issubdtype(sarr.dtype, jnp.floating):
+            # NaNs sort to the tail, so a lane contains one iff its last
+            # logical element is NaN — propagate like numpy does
+            vlast = jnp.expand_dims(jnp.take(sarr, n - 1, axis=eff_axis), eff_axis)
+            res = jnp.where(jnp.isnan(vlast), jnp.nan, res)
+        # numpy/jnp put the q dim first
+        result = jnp.moveaxis(res, eff_axis, 0)
+        if scalar_q:
+            result = jnp.squeeze(result, axis=0)
+        if keepdims:
+            # axis=None only reaches here for 1-D input (eff_axis 0)
+            result = jnp.expand_dims(
+                result, (axis if axis is not None else 0) + (0 if scalar_q else 1)
+            )
+    else:
+        arr = x.larray
+        if types.heat_type_is_exact(x.dtype):
+            arr = arr.astype(jnp.float32)
+        result = jnp.percentile(arr, q_arr, axis=axis, method=interpolation, keepdims=keepdims)
     # result has leading q dims when q is a vector
     ret = _wrap_reduce(jnp.asarray(result), x, axis, keepdims) if scalar_q else DNDarray(
         result,

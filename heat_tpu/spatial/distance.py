@@ -32,6 +32,9 @@ __all__ = ["cdist", "manhattan", "rbf"]
 
 
 def _prepare(X: DNDarray, Y: Optional[DNDarray]):
+    """Validate operands and resolve the compute dtype WITHOUT touching
+    array data — the ring path casts physical arrays itself, so eager
+    logical-array casts here would be two wasted full-array passes."""
     sanitize_in(X)
     if X.ndim != 2:
         raise ValueError(f"X must be 2-dimensional, got {X.ndim}")
@@ -46,14 +49,16 @@ def _prepare(X: DNDarray, Y: Optional[DNDarray]):
             )
         if types.heat_type_is_inexact(Y.dtype):
             promoted = types.promote_types(promoted, Y.dtype)
-    if promoted is types.float64:
-        jt = jnp.float64
-    else:
-        jt = jnp.float32
+    if promoted is not types.float64:
         promoted = types.float32
+    return promoted
+
+
+def _cast(X: DNDarray, Y: Optional[DNDarray], dtype):
+    jt = dtype.jax_type()
     x = X.larray.astype(jt)
     y = x if Y is None else Y.larray.astype(jt)
-    return x, y, promoted
+    return x, y
 
 
 def _wrap(result: jax.Array, X: DNDarray, Y: Optional[DNDarray], dtype) -> DNDarray:
@@ -82,10 +87,17 @@ def _ring_path(X: DNDarray, Y: Optional[DNDarray], metric: str, dtype) -> Option
     out = parallel.ring_pairwise(
         x_phys, y_phys, comm.mesh, comm.axis_name, metric=metric, symmetric=Y is None
     )
+    from ..core import _padding
+
     n_y = X.shape[0] if Y is None else Y.shape[0]
     gshape = (X.shape[0], n_y)
-    logical = out[: gshape[0], : gshape[1]]
-    phys = comm.shard(logical, 0)
+    # the ring output's ROW extent is already the canonical physical layout
+    # (pad_extent rows, split 0); only the column dim needs its logical
+    # slice (shard-local — columns are unsplit) and the pad rows re-zeroing
+    # (they hold distances computed against pad zeros). No unpad/repad
+    # round trip of the n×m matrix.
+    phys = _padding.mask_phys(out[:, : gshape[1]], gshape, 0)
+    phys = jax.device_put(phys, comm.sharding(2, 0))
     return DNDarray(phys, gshape, dtype, 0, X.device, comm)
 
 
@@ -100,12 +112,13 @@ def cdist(
     ``ring=True`` selects the explicit ppermute-ring schedule (half ring
     with symmetric fill when ``Y is None``) instead of GSPMD's derived
     collectives; results are identical."""
-    x, y, dtype = _prepare(X, Y)
+    dtype = _prepare(X, Y)
     if ring:
         metric = "euclidean" if quadratic_expansion else "euclidean_direct"
         out = _ring_path(X, Y, metric, dtype)
         if out is not None:
             return out
+    x, y = _cast(X, Y, dtype)
     if quadratic_expansion:
         # MXU form: ‖x‖² + ‖y‖² − 2 x·yᵀ
         x2 = jnp.sum(x * x, axis=1, keepdims=True)
@@ -122,11 +135,12 @@ def manhattan(
     X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False, ring: bool = False
 ) -> DNDarray:
     """Pairwise L1 distances (reference: distance.py:185)."""
-    x, y, dtype = _prepare(X, Y)
+    dtype = _prepare(X, Y)
     if ring:
         out = _ring_path(X, Y, "manhattan", dtype)
         if out is not None:
             return out
+    x, y = _cast(X, Y, dtype)
     diff = jnp.abs(x[:, None, :] - y[None, :, :])
     result = jnp.sum(diff, axis=-1)
     return _wrap(result, X, Y, dtype)
@@ -140,7 +154,7 @@ def rbf(
     ring: bool = False,
 ) -> DNDarray:
     """RBF kernel exp(−d²/(2σ²)) (reference: distance.py:158)."""
-    x, y, dtype = _prepare(X, Y)
+    dtype = _prepare(X, Y)
     if ring:
         metric = "sqeuclidean" if quadratic_expansion else "sqeuclidean_direct"
         d2_arr = _ring_path(X, Y, metric, dtype)
@@ -156,6 +170,7 @@ def rbf(
             return DNDarray(
                 vals, d2_arr.gshape, d2_arr.dtype, d2_arr.split, d2_arr.device, d2_arr.comm
             )
+    x, y = _cast(X, Y, dtype)
     if quadratic_expansion:
         x2 = jnp.sum(x * x, axis=1, keepdims=True)
         y2 = jnp.sum(y * y, axis=1, keepdims=True).T

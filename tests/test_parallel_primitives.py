@@ -201,3 +201,146 @@ class TestRingPairwise:
         X = ht.array(x_np)  # replicated, no ring possible
         d = ht.spatial.cdist(X, ring=True)
         np.testing.assert_allclose(d.numpy(), self._ref_cdist(x_np, x_np), rtol=1e-3, atol=1e-3)
+
+
+class TestDistributedSort:
+    """Gather-free split-axis sort (core.parallel.distributed_sort) — the
+    explicit-SPMD replacement for the reference's sample-sort + Alltoallv
+    (manipulations.py:2428)."""
+
+    @pytest.mark.parametrize("n", [8 * P, 8 * P - 3, P, 5])
+    def test_matches_numpy_1d(self, n):
+        rng = np.random.default_rng(n)
+        x = rng.standard_normal(n).astype(np.float32)
+        a = ht.array(x, split=0)
+        v, i = ht.sort(a)
+        np.testing.assert_allclose(v.numpy(), np.sort(x), rtol=1e-6)
+        np.testing.assert_allclose(x[i.numpy()], np.sort(x), rtol=1e-6)
+        vd, _ = ht.sort(a, descending=True)
+        np.testing.assert_allclose(vd.numpy(), np.sort(x)[::-1], rtol=1e-6)
+
+    def test_2d_split_axis_lanes(self):
+        rng = np.random.default_rng(0)
+        x = rng.integers(-9, 9, size=(4 * P + 1, 5)).astype(np.int32)
+        a = ht.array(x, split=0)
+        v, i = ht.sort(a, axis=0)
+        np.testing.assert_array_equal(v.numpy(), np.sort(x, axis=0))
+        np.testing.assert_array_equal(
+            np.take_along_axis(x, i.numpy(), axis=0), np.sort(x, axis=0)
+        )
+
+    def test_duplicates_exact_multiset(self):
+        # ties at shard boundaries: the composite (value, position) key must
+        # neither drop nor duplicate elements
+        x = np.tile(np.arange(3, dtype=np.float32), 5 * P)
+        a = ht.array(x, split=0)
+        v, _ = ht.sort(a)
+        np.testing.assert_array_equal(v.numpy(), np.sort(x))
+
+    def test_nan_and_inf_ordering(self):
+        x = np.array([3.0, np.nan, -np.inf, 1.0, np.inf, np.nan, 0.0, -1.0, 2.0, 5.0, -2.0],
+                     dtype=np.float64)
+        a = ht.array(x, split=0)
+        v, _ = ht.sort(a)
+        ref = np.sort(x)
+        np.testing.assert_array_equal(np.isnan(v.numpy()), np.isnan(ref))
+        np.testing.assert_allclose(v.numpy()[~np.isnan(ref)], ref[~np.isnan(ref)])
+
+    def test_pad_invariant_restored(self):
+        n = 8 * P - 3
+        x = np.random.default_rng(7).standard_normal(n).astype(np.float32) + 100.0
+        v, i = ht.sort(ht.array(x, split=0))
+        phys = np.asarray(jax.device_get(v._phys))
+        np.testing.assert_array_equal(phys[n:], 0.0)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_no_allgather_in_hlo(self):
+        # VERDICT r1 item 8 done-criterion: the compiled program must move
+        # data by collective-permute, never by full gather
+        from heat_tpu.core.parallel import _oddeven_sort_program
+
+        comm = ht.get_comm()
+        prog = _oddeven_sort_program(comm.mesh, comm.axis_name, 1, 0, "int32")
+        phys = comm.shard(jnp.arange(8.0 * P, dtype=jnp.float32), 0)
+        txt = prog.lower(phys).compile().as_text()
+        assert "all-gather" not in txt
+        assert "all-to-all" not in txt
+        assert "collective-permute" in txt
+
+
+class TestDistributedPercentile:
+    @pytest.mark.parametrize("n", [8 * P, 8 * P - 5])
+    @pytest.mark.parametrize("method", ["linear", "lower", "higher", "midpoint", "nearest"])
+    def test_methods_match_numpy(self, n, method):
+        rng = np.random.default_rng(n)
+        x = rng.standard_normal(n).astype(np.float64)
+        a = ht.array(x, split=0)
+        got = ht.percentile(a, 30.0, axis=0, interpolation=method).numpy()
+        np.testing.assert_allclose(got, np.percentile(x, 30.0, method=method), rtol=1e-6)
+
+    def test_vector_q_and_keepdims(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((5 * P + 1, 6))
+        a = ht.array(x, split=0)
+        np.testing.assert_allclose(
+            ht.percentile(a, [25.0, 75.0], axis=0).numpy(),
+            np.percentile(x, [25, 75], axis=0),
+            rtol=1e-6,
+        )
+        assert ht.percentile(a, 25.0, axis=0, keepdims=True).numpy().shape == (1, 6)
+        np.testing.assert_allclose(
+            ht.median(a, axis=0).numpy(), np.median(x, axis=0), rtol=1e-6
+        )
+
+
+class TestReviewRegressions:
+    """Regression tests for round-2 review findings."""
+
+    def test_convolve_same_even_kernel_after_swap(self):
+        # operand swap can make the kernel even though even kernels were
+        # rejected pre-swap; the distributed path must match numpy 'same'
+        small = np.arange(1, 5, dtype=np.float32)
+        big = np.arange(4 * P + 1, dtype=np.float32)
+        got = ht.convolve(ht.array(small), ht.array(big, split=0), mode="same")
+        np.testing.assert_allclose(got.numpy(), np.convolve(small, big, mode="same"), rtol=1e-5)
+
+    def test_percentile_nan_propagates(self):
+        x = np.array([1.0, np.nan, 3.0, 2.0] * (2 * P))
+        a = ht.array(x, split=0)
+        assert np.isnan(float(ht.percentile(a, 50.0, axis=0)))
+        got = ht.percentile(a, [25.0, 75.0], axis=0).numpy()
+        assert np.all(np.isnan(got))
+
+    def test_percentile_keepdims_axis_none(self):
+        a = ht.array(np.arange(16.0), split=0)
+        assert ht.percentile(a, 30.0, keepdims=True).numpy().shape == (1,)
+
+    def test_halo_cache_invalidated_on_rebind(self):
+        x = ht.arange(2 * P, split=0, dtype=ht.float32)
+        x.get_halo(1)
+        x.larray = np.arange(100.0, 100.0 + 2 * P).astype(np.float32)
+        fresh = np.asarray(jax.device_get(x.array_with_halos))
+        assert fresh.max() >= 100.0
+
+    def test_percentile_q_out_of_range_raises(self):
+        a = ht.array(np.arange(16.0), split=0)
+        with pytest.raises(ValueError):
+            ht.percentile(a, -5.0, axis=0)
+        with pytest.raises(ValueError):
+            ht.percentile(a, 150.0, axis=0)
+        b = ht.array(np.arange(16.0))  # replicated path: same contract
+        with pytest.raises(ValueError):
+            ht.percentile(b, -5.0, axis=0)
+
+    def test_values_only_sort_matches(self):
+        from heat_tpu.core import manipulations
+
+        rng = np.random.default_rng(11)
+        x = rng.standard_normal(8 * P - 3).astype(np.float32)
+        a = ht.array(x, split=0)
+        sv = manipulations._sorted_values(a, 0)
+        np.testing.assert_allclose(sv.numpy(), np.sort(x), rtol=1e-6)
+        # ties must partition exactly (rank-order concat + stable sort)
+        x = np.tile(np.arange(3, dtype=np.float32), 5 * P)
+        sv = manipulations._sorted_values(ht.array(x, split=0), 0)
+        np.testing.assert_array_equal(sv.numpy(), np.sort(x))
