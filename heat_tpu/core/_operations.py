@@ -5,14 +5,25 @@ API parity with /root/reference/heat/core/_operations.py: ``__binary_op``
 ``__reduce_op`` (:378). The reference versions interleave type promotion
 with explicit redistribution (`sanitize_distribution`) and MPI collectives
 (`Allreduce` when the reduction axis includes the split,
-_operations.py:466-471; `Exscan` for cumulative ops). Here the local torch
-kernel becomes a jnp/XLA op on the global sharded array: GSPMD inserts the
-equivalent collectives (a reduction over the sharded axis lowers to the
-same all-reduce over ICI), so these wrappers shrink to type promotion,
-split bookkeeping and sharding constraints.
+_operations.py:466-471; `Exscan` for cumulative ops).
+
+TPU execution model: every wrapper routes through a CACHED JITTED CALLABLE
+operating on the PHYSICAL (padded) arrays — one compiled XLA program per
+(op, shape, dtype, split) configuration, with dtype casts, pad-neutral
+refills and the zero-pad restore all fused into the same program and the
+output sharding pinned via ``out_shardings``. Uneven shapes therefore pay
+no per-op unpad→op→repad round trip, and a dispatch is one jitted call on
+an already-sharded array. The reference's collectives appear implicitly: a
+reduction over the sharded axis lowers to the same all-reduce over ICI.
+
+Irregular cases (``where=``, non-hashable kwargs, ops that change rank
+unexpectedly) fall back to an eager logical-array path with identical
+semantics.
 """
 
 from __future__ import annotations
+
+import functools
 
 import numpy as np
 
@@ -22,8 +33,7 @@ import jax.numpy as jnp
 from typing import Callable, Optional, Union
 
 from . import types
-from .communication import sanitize_comm
-from .devices import sanitize_device
+from . import _padding
 from .dndarray import DNDarray
 from .stride_tricks import broadcast_shape, sanitize_axis
 
@@ -41,6 +51,167 @@ def _as_dndarray(x, reference: DNDarray) -> DNDarray:
     )
 
 
+def _kw_key(kwargs: Optional[dict]):
+    """Hashable snapshot of an op's kwargs, or None when not cacheable."""
+    if not kwargs:
+        return ()
+    try:
+        items = tuple(sorted(kwargs.items()))
+        hash(items)
+        return items
+    except TypeError:
+        return None
+
+
+def _mask_tail(arr: jax.Array, split: int, n: int, fill=0) -> jax.Array:
+    """Fill positions >= n along ``split`` (the pad region) with ``fill``
+    — traceable (fuses into the surrounding program)."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, arr.shape, split)
+    return jnp.where(iota < n, arr, jnp.asarray(fill, dtype=arr.dtype))
+
+
+def _pad_operand(arr, out_ndim: int, split: int, pext: int):
+    """Align an operand's split-dim extent to the physical extent. A
+    replicated operand carries the logical extent; pad it (shapes are
+    static under trace, so this resolves at compile time). Extent-1
+    dims broadcast as-is."""
+    ndim = getattr(arr, "ndim", 0)
+    dim = split - (out_ndim - ndim)
+    if dim < 0:
+        return arr
+    ext = arr.shape[dim]
+    if ext in (1, pext):
+        return arr
+    widths = [(0, 0)] * ndim
+    widths[dim] = (0, pext - ext)
+    return jnp.pad(arr, widths)
+
+
+# neutral elements for pad refill when a reduction touches the split axis;
+# "min"/"max" resolve against the input dtype inside the traced program
+_REDUCE_NEUTRAL = {}
+
+
+def _register_neutrals():
+    table = [
+        (("sum", "nansum"), 0),
+        (("prod", "nanprod"), 1),
+        (("min", "amin", "nanmin"), "max"),
+        (("max", "amax", "nanmax"), "min"),
+        (("all",), True),
+        (("any",), False),
+    ]
+    for names, neutral in table:
+        for name in names:
+            fn = getattr(jnp, name, None)
+            if fn is not None:
+                _REDUCE_NEUTRAL[fn] = neutral
+
+
+_register_neutrals()
+
+
+def _resolve_neutral(tag, dtype):
+    if tag == "max":
+        return jnp.inf if jnp.issubdtype(dtype, jnp.inexact) else jnp.iinfo(dtype).max if jnp.issubdtype(dtype, jnp.integer) else True
+    if tag == "min":
+        return -jnp.inf if jnp.issubdtype(dtype, jnp.inexact) else jnp.iinfo(dtype).min if jnp.issubdtype(dtype, jnp.integer) else False
+    return tag
+
+
+# --------------------------------------------------------------------- #
+# cached jitted executors                                               #
+# --------------------------------------------------------------------- #
+@functools.lru_cache(maxsize=4096)
+def _binary_callable(op, comm, out_ndim, split, n, pext, cast, scalar1, scalar2, kw):
+    """One compiled program: cast → align pads → op → restore zero pad.
+    ``scalar1/2`` record which operands arrived as Python scalars — those
+    keep their weak dtype so promotion matches eager numpy/jnp semantics."""
+    def fn(a, b):
+        if cast is not None:
+            jt = jnp.dtype(cast)
+            if not scalar1:
+                a = a.astype(jt)
+            if not scalar2:
+                b = b.astype(jt)
+        if split is not None:
+            a = _pad_operand(a, out_ndim, split, pext)
+            b = _pad_operand(b, out_ndim, split, pext)
+        r = op(a, b, **dict(kw))
+        if split is not None and pext != n:
+            r = _mask_tail(r, split, n)
+        return r
+
+    return jax.jit(fn, out_shardings=comm.sharding(out_ndim, split))
+
+
+@functools.lru_cache(maxsize=4096)
+def _unary_callable(op, comm, ndim, split, n, pext, cast, kw):
+    def fn(arr):
+        if cast is not None:
+            arr = arr.astype(jnp.dtype(cast))
+        r = op(arr, **dict(kw))
+        if split is not None and pext != n:
+            r = _mask_tail(r, split, n)
+        return r
+
+    return jax.jit(fn, out_shardings=comm.sharding(ndim, split))
+
+
+@functools.lru_cache(maxsize=4096)
+def _reduce_callable(op, comm, split, n, pext, axes, keepdims, neutral, out_ndim, out_split, out_n, out_pext, kw):
+    def fn(arr):
+        if split is not None and pext != n and neutral is not None:
+            arr = _mask_tail(arr, split, n, _resolve_neutral(neutral, arr.dtype))
+        r = op(arr, axis=axes, keepdims=keepdims, **dict(kw))
+        if not isinstance(r, jax.Array) and not hasattr(r, "ndim"):
+            r = jnp.asarray(r)
+        if out_split is not None and out_pext != out_n:
+            r = _mask_tail(r, out_split, out_n)
+        return r
+
+    return jax.jit(fn, out_shardings=comm.sharding(out_ndim, out_split))
+
+
+@functools.lru_cache(maxsize=1024)
+def _cum_callable(op, comm, ndim, split, n, pext, axis, cast):
+    def fn(arr):
+        if cast is not None:
+            arr = arr.astype(jnp.dtype(cast))
+        r = op(arr, axis=axis)
+        if split is not None and pext != n:
+            r = _mask_tail(r, split, n)
+        return r
+
+    return jax.jit(fn, out_shardings=comm.sharding(ndim, split))
+
+
+@functools.lru_cache(maxsize=4096)
+def _local_probe_keeps_shape(op, shape, dtype, cast, kw) -> bool:
+    """True iff ``op`` maps an array of (shape, dtype[, cast]) to the same
+    shape — the condition for running it on the physical array."""
+    def probe(a):
+        if cast is not None:
+            a = a.astype(jnp.dtype(cast))
+        return op(a, **dict(kw))
+
+    try:
+        res = jax.eval_shape(probe, jax.ShapeDtypeStruct(shape, jnp.dtype(dtype)))
+    except Exception:
+        return False
+    return hasattr(res, "shape") and tuple(res.shape) == tuple(shape)
+
+
+def _phys_meta(x: DNDarray):
+    """(logical n, physical ext) along the split axis, or (None, None)."""
+    if x.split is None:
+        return None, None
+    return x.gshape[x.split], x._phys.shape[x.split]
+
+
+# --------------------------------------------------------------------- #
+# wrappers                                                              #
+# --------------------------------------------------------------------- #
 def __binary_op(
     operation: Callable,
     t1: Union[DNDarray, int, float],
@@ -53,9 +224,9 @@ def __binary_op(
 
     Promotes types on the torch/XLA lattice, broadcasts, resolves the
     output split by the dominant-operand rule (reference
-    _operations.py:147-168) and applies ``operation`` to the global arrays;
-    distribution matching is a resharding constraint instead of explicit
-    redistribution.
+    _operations.py:147-168) and executes ONE cached jitted program on the
+    physical arrays; distribution matching is a resharding constraint
+    instead of explicit redistribution.
     """
     fn_kwargs = fn_kwargs or {}
 
@@ -64,15 +235,15 @@ def __binary_op(
 
     ref = t1 if isinstance(t1, DNDarray) else t2
 
-    # scalar fast-path: keep weak typing so int + float32-array stays float32
     scalar1 = not isinstance(t1, DNDarray)
     scalar2 = not isinstance(t2, DNDarray)
 
     promoted = types.result_type(t1, t2)
     jt = promoted.jax_type()
 
-    a1 = t1 if scalar1 else t1.larray
-    a2 = t2 if scalar2 else t2.larray
+    # non-DNDarray array-likes become concrete arrays up front
+    a1 = t1 if scalar1 else None
+    a2 = t2 if scalar2 else None
     if scalar1 and not isinstance(t1, (int, float, complex, bool)):
         a1 = jnp.asarray(np.asarray(t1))
         scalar1 = False
@@ -80,43 +251,84 @@ def __binary_op(
         a2 = jnp.asarray(np.asarray(t2))
         scalar2 = False
 
-    if not scalar1:
-        a1 = a1.astype(jt)
-    if not scalar2:
-        a2 = a2.astype(jt)
-
-    shape1 = () if scalar1 else tuple(t1.shape) if isinstance(t1, DNDarray) else tuple(a1.shape)
-    shape2 = () if scalar2 else tuple(t2.shape) if isinstance(t2, DNDarray) else tuple(a2.shape)
+    shape1 = () if a1 is not None and scalar1 else tuple(t1.shape) if isinstance(t1, DNDarray) else tuple(np.shape(a1))
+    shape2 = () if a2 is not None and scalar2 else tuple(t2.shape) if isinstance(t2, DNDarray) else tuple(np.shape(a2))
     output_shape = broadcast_shape(shape1, shape2)
     out_ndim = len(output_shape)
 
-    # dominant split resolution in output coordinates
-    def _out_split(t, shape):
+    def _out_split(t):
         if not isinstance(t, DNDarray) or t.split is None:
             return None
         return t.split + (out_ndim - t.ndim)
 
-    s1 = _out_split(t1, shape1)
-    s2 = _out_split(t2, shape2)
+    s1 = _out_split(t1)
+    s2 = _out_split(t2)
     if s1 is not None and s2 is not None and s1 != s2:
         # align t2 to t1's split (reference redistributes the non-dominant operand)
-        t2 = t2.resplit(s1 - (out_ndim - t2.ndim)) if 0 <= s1 - (out_ndim - t2.ndim) else t2
-        a2 = t2.larray.astype(jt)
-        s2 = _out_split(t2, shape2)
+        tgt = s1 - (out_ndim - t2.ndim)
+        if tgt >= 0:
+            t2 = t2.resplit(tgt)
+        s2 = _out_split(t2)
     output_split = s1 if s1 is not None else s2
     # a broadcast dimension of extent 1 cannot carry the split
     if output_split is not None and output_shape[output_split] == 1:
         output_split = None
 
-    result = operation(a1, a2, **fn_kwargs)
+    comm = ref.comm
+    device = ref.device
+    kw = _kw_key(fn_kwargs)
+
+    if where is None and kw is not None:
+        # fast path: one jitted program over physical operands
+        n = output_shape[output_split] if output_split is not None else 0
+        pext = _padding.pad_extent(n, comm.size) if output_split is not None else 0
+
+        def _operand(t, a, is_scalar):
+            if is_scalar or not isinstance(t, DNDarray):
+                return a
+            if output_split is not None and t.split is not None:
+                if t.split + (out_ndim - t.ndim) == output_split:
+                    # a logical extent-1 dim must BROADCAST; its physical
+                    # pad extent would pair row-by-row instead
+                    if t.gshape[t.split] == 1 and t._phys.shape[t.split] != 1:
+                        return t.larray
+                    return t._phys
+            # replicated operand, operand split off the output split, or
+            # output_split nulled (extent-1): the physical pad would either
+            # fail to broadcast or leak pad rows — feed the logical view
+            return t.larray
+
+        x1 = _operand(t1, a1, scalar1)
+        x2 = _operand(t2, a2, scalar2)
+        prog = _binary_callable(
+            operation, comm, out_ndim, output_split, n, pext, np.dtype(jt).name,
+            scalar1, scalar2, kw,
+        )
+        result = prog(x1, x2)
+        res_type = types.canonical_heat_type(result.dtype)
+        if out is not None:
+            from .sanitation import sanitize_out
+
+            sanitize_out(out, output_shape, output_split, device)
+            if out.split == output_split:
+                out._set_phys(result.astype(out.dtype.jax_type()))
+            else:
+                out.larray = _padding.unpad(result, output_shape, output_split).astype(
+                    out.dtype.jax_type()
+                )
+            return out
+        return DNDarray(result, output_shape, res_type, output_split, device, comm)
+
+    # eager fallback (where= masking, or uncacheable kwargs)
+    b1 = a1 if scalar1 else (t1.larray.astype(jt) if isinstance(t1, DNDarray) else a1.astype(jt))
+    b2 = a2 if scalar2 else (t2.larray.astype(jt) if isinstance(t2, DNDarray) else a2.astype(jt))
+    result = operation(b1, b2, **fn_kwargs)
 
     if where is not None:
         w = where.larray if isinstance(where, DNDarray) else jnp.asarray(where)
         base = out.larray.astype(result.dtype) if out is not None else jnp.zeros_like(result)
         result = jnp.where(w, result, base)
 
-    comm = ref.comm
-    device = ref.device
     if output_split is not None:
         result = comm.shard(result, output_split)
 
@@ -124,11 +336,8 @@ def __binary_op(
     if out is not None:
         from .sanitation import sanitize_out
 
-        from . import _padding
-
         sanitize_out(out, output_shape, output_split, device)
-        buffered = _padding.unpad(result, output_shape, output_split).astype(out.dtype.jax_type())
-        out.larray = buffered
+        out.larray = _padding.unpad(result, output_shape, output_split).astype(out.dtype.jax_type())
         return out
 
     return DNDarray(result, output_shape, res_type, output_split, device, comm)
@@ -143,7 +352,9 @@ def __cum_op(
 ) -> DNDarray:
     """Generic cumulative op (reference: _operations.py:204 — local cumop +
     ``Exscan`` + combine). A jnp cumulative op on the sharded array lowers
-    to the same scan-with-carry across shards.
+    to the same scan-with-carry across shards. Pad rows sit at the global
+    tail, so the logical prefix of the cumulation is unaffected; the output
+    pad is re-zeroed inside the program.
     """
     from .sanitation import sanitize_in
 
@@ -152,23 +363,25 @@ def __cum_op(
     if axis is None:
         raise NotImplementedError("cumulative operation over flattened array: ravel first")
 
-    arr = x.larray
+    cast = None
     if dtype is not None:
         dtype = types.canonical_heat_type(dtype)
-        arr = arr.astype(dtype.jax_type())
-    result = operation(arr, axis=axis)
-    res_type = types.canonical_heat_type(result.dtype)
+        cast = np.dtype(dtype.jax_type()).name
+
     comm = x.comm
-    if x.split is not None:
-        result = comm.shard(result, x.split)
+    n, pext = _phys_meta(x)
+    prog = _cum_callable(operation, comm, x.ndim, x.split, n, pext, axis, cast)
+    result = prog(x._phys)
+    res_type = types.canonical_heat_type(result.dtype)
 
     if out is not None:
         from .sanitation import sanitize_out
 
-        from . import _padding
-
         sanitize_out(out, x.shape, x.split, x.device)
-        out.larray = _padding.unpad(result, x.shape, x.split).astype(out.dtype.jax_type())
+        if out.split == x.split:
+            out._set_phys(result.astype(out.dtype.jax_type()))
+        else:
+            out.larray = _padding.unpad(result, x.shape, x.split).astype(out.dtype.jax_type())
         return out
     return DNDarray(result, x.shape, res_type, x.split, x.device, comm)
 
@@ -182,25 +395,57 @@ def __local_op(
 ) -> DNDarray:
     """Generic pure-local elementwise op (reference: _operations.py:305) —
     no communication; sharding is preserved by XLA elementwise semantics.
+    Runs as one cached jitted program on the physical array (cast and
+    zero-pad restore fused in).
     """
     from .sanitation import sanitize_in
 
     sanitize_in(x)
-    arr = x.larray
+    cast = None
     if not no_cast and types.heat_type_is_exact(x.dtype):
         promoted = types.promote_types(x.dtype, types.float32)
-        arr = arr.astype(promoted.jax_type())
+        cast = np.dtype(promoted.jax_type()).name
 
+    kw = _kw_key(kwargs)
+    if kw is None:
+        # uncacheable kwargs: eager logical path
+        return _local_op_eager(operation, x, out, cast, **kwargs)
+
+    comm = x.comm
+    n, pext = _phys_meta(x)
+    if not _local_probe_keeps_shape(
+        operation, tuple(x._phys.shape), np.dtype(x._phys.dtype).name, cast, kw
+    ):
+        return _local_op_eager(operation, x, out, cast, **kwargs)
+
+    prog = _unary_callable(operation, comm, x.ndim, x.split, n, pext, cast, kw)
+    result = prog(x._phys)
+    res_type = types.canonical_heat_type(result.dtype)
+
+    if out is not None:
+        from .sanitation import sanitize_out
+
+        sanitize_out(out, x.shape, x.split, x.device)
+        if out.split == x.split:
+            out._set_phys(result.astype(out.dtype.jax_type()))
+        else:
+            out.larray = _padding.unpad(result, x.shape, x.split).astype(out.dtype.jax_type())
+        return out
+    return DNDarray(result, x.shape, res_type, x.split, x.device, x.comm)
+
+
+def _local_op_eager(operation, x, out, cast, **kwargs):
+    arr = x.larray
+    if cast is not None:
+        arr = arr.astype(jnp.dtype(cast))
     result = operation(arr, **kwargs)
     res_type = types.canonical_heat_type(result.dtype)
     split = x.split if result.ndim == x.ndim else None
     output_shape = tuple(int(s) for s in result.shape)
     if split is not None:
         result = x.comm.shard(result, split)
-
     if out is not None:
         from .sanitation import sanitize_out
-        from . import _padding
 
         sanitize_out(out, output_shape, split, x.device)
         out.larray = _padding.unpad(result, output_shape, split).astype(out.dtype.jax_type())
@@ -219,8 +464,10 @@ def __reduce_op(
 ) -> DNDarray:
     """Generic reduction (reference: _operations.py:378 — local partial
     reduce followed by ``Allreduce`` when ``split in axis``,
-    _operations.py:466-471). The jnp reduction over the sharded global
-    array makes XLA emit that same all-reduce over the mesh.
+    _operations.py:466-471). The jnp reduction over the sharded physical
+    array makes XLA emit that same all-reduce over ICI; pad rows are
+    refilled with the op's neutral element inside the compiled program
+    when the reduction touches the split axis.
     """
     from .sanitation import sanitize_in
 
@@ -228,15 +475,11 @@ def __reduce_op(
     axis = sanitize_axis(x.shape, axis)
 
     kwargs.pop("out", None)
-    result = partial_op(x.larray, axis=axis, keepdims=keepdims, **kwargs)
-    if not isinstance(result, jax.Array):
-        result = jnp.asarray(result)
+    kw = _kw_key(kwargs)
 
     # output split bookkeeping
     split = x.split
-    if split is None:
-        output_split = None
-    elif axis is None:
+    if split is None or axis is None:
         output_split = None
     else:
         axes = (axis,) if isinstance(axis, int) else tuple(axis)
@@ -248,18 +491,60 @@ def __reduce_op(
             output_split = split - sum(1 for a in axes if a < split)
 
     comm = x.comm
-    output_shape = tuple(int(s) for s in result.shape)
-    if output_split is not None:
-        result = comm.shard(result, output_split)
+    n, pext = _phys_meta(x)
+    touches_split = split is not None and (
+        axis is None or split in ((axis,) if isinstance(axis, int) else tuple(axis))
+    )
+    if neutral is None:
+        neutral = _REDUCE_NEUTRAL.get(partial_op)
 
+    if kw is None or (touches_split and pext != n and neutral is None):
+        # eager logical fallback: unknown neutral with a real pad region
+        result = partial_op(x.larray, axis=axis, keepdims=keepdims, **kwargs)
+        if not isinstance(result, jax.Array):
+            result = jnp.asarray(result)
+        output_shape = tuple(int(s) for s in result.shape)
+        if output_split is not None:
+            result = comm.shard(result, output_split)
+        res_type = types.canonical_heat_type(result.dtype)
+        if out is not None:
+            from .sanitation import sanitize_out
+
+            sanitize_out(out, output_shape, output_split, x.device)
+            out.larray = _padding.unpad(result, output_shape, output_split).astype(out.dtype.jax_type())
+            return out
+        return DNDarray(result, output_shape, res_type, output_split, x.device, comm)
+
+    # fast path: compute output geometry statically
+    in_shape = x.gshape
+    if axis is None:
+        output_shape = (1,) * x.ndim if keepdims else ()
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        if keepdims:
+            output_shape = tuple(1 if i in axes else s for i, s in enumerate(in_shape))
+        else:
+            output_shape = tuple(s for i, s in enumerate(in_shape) if i not in axes)
+    out_ndim = len(output_shape)
+    out_n = output_shape[output_split] if output_split is not None else 0
+    out_pext = _padding.pad_extent(out_n, comm.size) if output_split is not None else 0
+
+    axes_key = axis if (axis is None or isinstance(axis, int)) else tuple(axis)
+    prog = _reduce_callable(
+        partial_op, comm, split, n, pext, axes_key, keepdims,
+        neutral if (touches_split and pext != n) else None,
+        out_ndim, output_split, out_n, out_pext, kw,
+    )
+    result = prog(x._phys)
     res_type = types.canonical_heat_type(result.dtype)
 
     if out is not None:
         from .sanitation import sanitize_out
 
-        from . import _padding
-
         sanitize_out(out, output_shape, output_split, x.device)
-        out.larray = _padding.unpad(result, output_shape, output_split).astype(out.dtype.jax_type())
+        if out.split == output_split:
+            out._set_phys(result.astype(out.dtype.jax_type()))
+        else:
+            out.larray = _padding.unpad(result, output_shape, output_split).astype(out.dtype.jax_type())
         return out
     return DNDarray(result, output_shape, res_type, output_split, x.device, comm)

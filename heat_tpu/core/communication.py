@@ -45,6 +45,7 @@ __all__ = [
     "get_comm",
     "use_comm",
     "sanitize_comm",
+    "init_distributed",
 ]
 
 
@@ -217,29 +218,46 @@ class MeshCommunication(Communication):
     # ------------------------------------------------------------------ #
     # communicator management                                            #
     # ------------------------------------------------------------------ #
-    def Split(self, color: int = 0, key: int = 0) -> "MeshCommunication":
-        """Sub-communicator over a subset of devices, MPI ``Comm.Split``
-        semantics adapted to the single-controller model: callers pass a
-        mapping ``device index -> color`` implicitly by calling once per
-        color they want; since one process owns all devices, ``color``
-        selects the devices whose block index matches it when the mesh is
-        divided into ``key+1``-sized... — in practice, hierarchical
-        algorithms here should slice ``devices`` explicitly. This helper
-        partitions the mesh into contiguous blocks and returns block
-        ``color``; ``key`` sets the number of blocks (default 2).
+    def Split(self, color=0, key=0):
+        """MPI ``Comm.Split`` with faithful semantics, adapted to the
+        single-controller model (reference wraps mpi4py's Split). In MPI
+        every rank passes its own ``(color, key)``; ranks sharing a color
+        form a sub-communicator ordered by ``(key, old rank)``. Here ONE
+        controller owns every device, so the caller passes the full
+        per-device vectors:
+
+        - ``color``: int → all devices share it (an MPI all-same-color
+          Split, i.e. a dup): returns one ``MeshCommunication``.
+        - ``color``: sequence of ints, one per device → returns a dict
+          ``{color: MeshCommunication}``, each group's devices ordered by
+          ``(key[i], i)``; ``key`` may be a scalar or a per-device
+          sequence. Devices with negative color (MPI_UNDEFINED analog)
+          join no group.
         """
-        nblocks = max(2, int(key) if key else 2)
         size = self.size
-        if size == 1:
+        if isinstance(color, (int, np.integer)):
             return MeshCommunication(self._devices, self.axis_name)
-        block = -(-size // nblocks)
-        start = color * block
-        members = self._devices[start : start + block]
-        if not members:
-            raise ValueError(
-                f"color {color} selects no devices (mesh size {size}, {nblocks} blocks)"
+        colors = [int(c) for c in color]
+        if len(colors) != size:
+            raise ValueError(f"color vector must have one entry per device ({size}), got {len(colors)}")
+        if isinstance(key, (int, np.integer)):
+            keys = [int(key)] * size
+        else:
+            keys = [int(k) for k in key]
+            if len(keys) != size:
+                raise ValueError(f"key vector must have one entry per device ({size}), got {len(keys)}")
+        groups = {}
+        for i, c in enumerate(colors):
+            if c < 0:
+                continue
+            groups.setdefault(c, []).append(i)
+        return {
+            c: MeshCommunication(
+                [self._devices[i] for i in sorted(idx, key=lambda i: (keys[i], i))],
+                self.axis_name,
             )
-        return MeshCommunication(members, self.axis_name)
+            for c, idx in groups.items()
+        }
 
     def __repr__(self) -> str:
         return f"MeshCommunication(size={self.size}, axis={self.axis_name!r}, platform={self._devices[0].platform if self._devices else '-'})"
@@ -247,6 +265,52 @@ class MeshCommunication(Communication):
 
 # reference-compatible alias: programs written against the reference name
 MPICommunication = MeshCommunication
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> MeshCommunication:
+    """Multi-host bootstrap — the single-controller replacement for the
+    reference's ``mpirun -n N`` world creation (communication.py:2012).
+
+    Where Heat relies on MPI to spawn one rank per process and wires them
+    with mpi4py, the TPU runtime runs ONE controller per host:
+    ``jax.distributed.initialize`` connects the hosts (args can also come
+    from the cluster environment: TPU pods auto-detect all four), after
+    which ``jax.devices()`` spans every host's chips and the world
+    communicator's mesh covers the full slice — collectives ride ICI
+    within a slice and DCN across slices. Call this ONCE, before any array
+    creation, on every host; each host then runs the SAME program
+    (SPMD single-controller-per-host, not rank-divergent control flow).
+
+    Returns the rebuilt world communicator (also installed as the global
+    default, so ``ht.array(..., split=0)`` shards over all hosts).
+    """
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+
+    # rebuild the world IN PLACE: star-imported copies of MPI_WORLD
+    # (heat_tpu.MPI_WORLD, pre-init local references) must all observe the
+    # new global device set — rebinding the module global would leave them
+    # pointing at the stale single-host world
+    MPI_WORLD.__init__()
+
+    global __default_comm
+    __default_comm = MPI_WORLD
+    return MPI_WORLD
 
 
 class _SelfCommunication(MeshCommunication):

@@ -1,16 +1,25 @@
 """Iterative solvers.
 
 API parity with /root/reference/heat/core/linalg/solver.py (``cg`` :14,
-``lanczos`` :67). Both are written *on top of* the distributed array API —
-exactly like the reference — so they inherit sharding from matmul/sum; the
-per-iteration collectives (dot-product all-reduces) are emitted by XLA.
+``lanczos`` :67). The reference iterates in Python with an MPI-synchronized
+convergence check each step; on TPU that pattern costs a device→host sync
+per iteration. Here each solver is ONE jitted program: ``cg`` runs a
+``lax.while_loop`` whose convergence test stays on device, ``lanczos`` a
+``lax.scan`` over steps with masked full reorthogonalization against the
+pre-allocated Krylov basis. The per-iteration dot-product all-reduces are
+emitted by XLA from the sharded matvecs — the same collectives the
+reference issues explicitly.
 """
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from typing import Optional, Tuple
 
@@ -22,10 +31,39 @@ from ..sanitation import sanitize_in
 __all__ = ["cg", "lanczos"]
 
 
+@functools.lru_cache(maxsize=64)
+def _cg_program(n: int, jdtype: str, maxit: int, tol: float):
+    """One jitted CG solve: while_loop with on-device convergence (no
+    host round trip per iteration, unlike the reference's per-step
+    ``sqrt(rsnew) < tol`` Python check, solver.py:45)."""
+    eps = jnp.asarray(tol, dtype=jdtype) ** 2
+
+    def solve(A, b, x0):
+        r0 = b - A @ x0
+        rs0 = r0 @ r0
+
+        def cond(state):
+            i, x, r, p, rsold = state
+            return (i < maxit) & (rsold >= eps)
+
+        def step(state):
+            i, x, r, p, rsold = state
+            Ap = A @ p
+            alpha = rsold / (p @ Ap)
+            x = x + alpha * p
+            r = r - alpha * Ap
+            rsnew = r @ r
+            p = r + (rsnew / rsold) * p
+            return (i + 1, x, r, p, rsnew)
+
+        _, x, _, _, _ = lax.while_loop(cond, step, (0, x0, r0, r0, rs0))
+        return x
+
+    return jax.jit(solve)
+
+
 def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
     """Conjugate gradients for s.p.d. ``A x = b`` (reference: solver.py:14)."""
-    from . import basics
-
     if not isinstance(A, DNDarray) or not isinstance(b, DNDarray) or not isinstance(x0, DNDarray):
         raise TypeError(f"A, b, x0 need to be DNDarrays, got {type(A)}, {type(b)}, {type(x0)}")
     if A.ndim != 2:
@@ -35,29 +73,65 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     if x0.ndim != 1:
         raise RuntimeError("c needs to be a 1D vector")
 
-    r = b - basics.matmul(A, x0)
-    p = r
-    rsold = basics.matmul(r, r)
-    x = x0
+    dtype = types.promote_types(
+        types.promote_types(A.dtype, b.dtype),
+        types.promote_types(x0.dtype, types.float32),
+    )
+    jt = dtype.jax_type()
+    n = b.shape[0]
+    prog = _cg_program(n, np.dtype(jt).name, int(n), 1e-10)
+    x = prog(A.larray.astype(jt), b.larray.astype(jt), x0.larray.astype(jt))
 
-    for _ in range(len(b)):
-        Ap = basics.matmul(A, p)
-        alpha = rsold / basics.matmul(p, Ap)
-        x = x + alpha * p
-        r = r - alpha * Ap
-        rsnew = basics.matmul(r, r)
-        if float(jnp.sqrt(rsnew.larray)) < 1e-10:
-            if out is not None:
-                out.larray = x.larray
-                return out
-            return x
-        p = r + (rsnew / rsold) * p
-        rsold = rsnew
-
+    result = DNDarray(
+        b.comm.shard(x, b.split), (n,), dtype, b.split, b.device, b.comm
+    )
     if out is not None:
-        out.larray = x.larray
+        out.larray = result.larray
         return out
-    return x
+    return result
+
+
+@functools.lru_cache(maxsize=64)
+def _lanczos_program(n: int, m: int, jdtype: str, breakdown_tol: float):
+    """One jitted Lanczos run: scan over the m steps; each step does the
+    matvec, masked full reorthogonalization against the basis so far
+    (reference solver.py:245-255 Gram-Schmidts every new vector), and a
+    ``lax.cond``-free invariant-subspace restart via a select on a fresh
+    random direction (reference draws a random vector on breakdown)."""
+    tol = breakdown_tol
+
+    def run(A, v0, key):
+        V0 = jnp.zeros((n, m), dtype=jdtype).at[:, 0].set(v0)
+        w0 = A @ v0
+        a0 = w0 @ v0
+        w0 = w0 - a0 * v0
+        alpha0 = jnp.zeros((m,), dtype=jdtype).at[0].set(a0)
+        beta0 = jnp.zeros((m,), dtype=jdtype)
+
+        def step(carry, i):
+            V, w, alpha, beta = carry
+            b_i = jnp.sqrt(w @ w)
+            invariant = b_i < tol
+            # normal candidate (safe divide) vs random restart direction
+            vi = jnp.where(invariant, jax.random.normal(jax.random.fold_in(key, i), (n,), dtype=jdtype), w / jnp.where(invariant, 1.0, b_i))
+            # full reorthogonalization against columns < i (masked)
+            proj = V.T @ vi
+            proj = jnp.where(jnp.arange(m) < i, proj, 0.0)
+            vi = vi - V @ proj
+            vi = vi / jnp.sqrt(vi @ vi)
+            V = lax.dynamic_update_slice_in_dim(V, vi[:, None], i, axis=1)
+            w = A @ vi
+            a_i = w @ vi
+            v_prev = lax.dynamic_slice_in_dim(V, i - 1, 1, axis=1)[:, 0]
+            w = w - a_i * vi - b_i * v_prev
+            alpha = alpha.at[i].set(a_i)
+            beta = beta.at[i].set(b_i)
+            return (V, w, alpha, beta), None
+
+        (V, _, alpha, beta), _ = lax.scan(step, (V0, w0, alpha0, beta0), jnp.arange(1, m))
+        return V, alpha, beta
+
+    return jax.jit(run)
 
 
 def lanczos(
@@ -83,6 +157,7 @@ def lanczos(
 
     n = A.shape[0]
     dtype = A.dtype if types.heat_type_is_inexact(A.dtype) else types.float32
+    jt = dtype.jax_type()
 
     if v0 is None:
         from .. import random as _random
@@ -94,42 +169,28 @@ def lanczos(
             v0 = v0.resplit(A.split)
         v0 = v0.astype(dtype)
 
-    # iteration state on host lists; each step is sharded device math
-    alpha = np.zeros(m, dtype=np.float64)
-    beta = np.zeros(m, dtype=np.float64)
-    vectors = [v0]
+    if m == 1:
+        w = basics.matmul(A, v0)
+        alpha = np.array([float(basics.matmul(w, v0))])
+        beta = np.zeros(1)
+        V_arr = v0.larray[:, None]
+    else:
+        from .. import random as _random
 
-    w = basics.matmul(A, v0)
-    alpha[0] = float(basics.matmul(w, v0))
-    w = w - alpha[0] * v0
+        prog = _lanczos_program(n, m, np.dtype(jt).name, 1e-10)
+        key = jax.random.key(int(_random.randint(0, 2**31 - 1, (1,)).numpy()[0]))
+        V_arr, alpha_d, beta_d = prog(A.larray.astype(jt), v0.larray, key)
+        alpha = np.asarray(jax.device_get(alpha_d), dtype=np.float64)
+        beta = np.asarray(jax.device_get(beta_d), dtype=np.float64)
 
-    for i in range(1, int(m)):
-        beta[i] = float(basics.norm(w))
-        if abs(beta[i]) < 1e-10:
-            # invariant subspace found: restart with a random orthogonal vector
-            from .. import random as _random
-
-            vr = _random.rand(n, split=A.split, device=A.device, comm=A.comm).astype(dtype)
-            # Gram-Schmidt against previous vectors
-            for v in vectors:
-                vr = vr - basics.matmul(vr, v) * v
-            vi = vr / basics.norm(vr)
-        else:
-            vi = w / beta[i]
-            # full reorthogonalization against the basis so far — without it
-            # the Krylov basis drifts after ~20 steps (reference
-            # solver.py:245-255 Gram-Schmidts every new vector)
-            for v in vectors:
-                vi = vi - basics.matmul(vi, v) * v
-            vi = vi / basics.norm(vi)
-        vectors.append(vi)
-        w = basics.matmul(A, vi)
-        alpha[i] = float(basics.matmul(w, vi))
-        w = w - alpha[i] * vi - beta[i] * vectors[i - 1]
-
-    from .. import manipulations
-
-    V = manipulations.stack(vectors, axis=1)
+    V = DNDarray(
+        A.comm.shard(V_arr, A.split if A.split in (0, None) else 0),
+        (n, m),
+        dtype,
+        A.split if A.split in (0, None) else 0,
+        A.device,
+        A.comm,
+    )
     T_np = np.diag(alpha) + np.diag(beta[1:], 1) + np.diag(beta[1:], -1)
     T = factories.array(T_np, dtype=dtype, comm=A.comm, device=A.device)
 

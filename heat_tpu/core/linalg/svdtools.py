@@ -53,14 +53,62 @@ from ._lapack import safe_svd, svd_x32_scope
 __all__ = ["hsvd", "hsvd_rank", "hsvd_rtol"]
 
 
+_SKETCH_OVERSAMPLE = 10
+_SKETCH_POWER_ITERS = 1
+
+
+def _sketched_uds(a_blk, keep: int, sketch_l: int):
+    """Randomized truncated SVD (Halko–Martinsson–Tropp range finder with
+    one power iteration): U·Σ of the best rank-``keep`` approximation in
+    O(m·n·l) instead of the O(m·n²) full SVD the reference's
+    ``compute_local_truncated_svd`` (svdtools.py:477) pays for a small
+    rank budget. The discarded-energy term stays EXACT for the factors
+    actually returned: ‖A‖²_F − Σσ̂² is the Frobenius residual of the
+    computed orthonormal factorization, so the a-posteriori bound is
+    unchanged in kind. All matmuls are MXU-shaped.
+
+    Returns (u (m, keep) orthonormal, s (keep,), err_sq (), norm_sq ())."""
+    m, n = a_blk.shape
+    key = jax.random.key(0x5BD)  # deterministic, like the reference's SVD
+    g = jax.random.normal(key, (n, sketch_l), dtype=a_blk.dtype)
+    y = a_blk @ g
+    for _ in range(_SKETCH_POWER_ITERS):
+        y = a_blk @ (a_blk.T @ y)
+    q, _ = jnp.linalg.qr(y)
+    b = q.T @ a_blk                      # (l, n) small
+    u_b, s, _ = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ u_b[:, :keep]
+    s = s[:keep]
+    norm_sq = jnp.sum(a_blk * a_blk)
+    err_sq = jnp.maximum(norm_sq - jnp.sum(s * s), 0.0)
+    return u, s, err_sq, norm_sq
+
+
 @functools.lru_cache(maxsize=128)
-def _local_svd_fn(mesh, axis_name: str, lrows: int, lcols: int, rloc: int, jdtype: str):
+def _sketched_single_fn(keep: int, sketch_l: int):
+    """Jitted single-device randomized truncated SVD."""
+    return jax.jit(lambda arr: _sketched_uds(arr, keep, sketch_l))
+
+
+@functools.lru_cache(maxsize=128)
+def _local_svd_fn(
+    mesh, axis_name: str, lrows: int, lcols: int, rloc: int, jdtype: str,
+    sketch_l: Optional[int] = None,
+):
     """Compiled level-0 kernel: per-shard truncated SVD → U·Σ block plus
     discarded-energy scalar (the analog of reference
-    ``compute_local_truncated_svd``, svdtools.py:477)."""
+    ``compute_local_truncated_svd``, svdtools.py:477). With ``sketch_l``
+    the block SVD is the randomized range-finder variant."""
 
     def kernel(a_blk):
         # a_blk: (lrows, lcols) local column block of A (split=1 layout)
+        if sketch_l is not None:
+            keep = min(rloc, min(a_blk.shape))
+            u, s, err_sq, norm_sq = _sketched_uds(a_blk, keep, sketch_l)
+            u_scaled = u * s
+            if keep < rloc:
+                u_scaled = jnp.pad(u_scaled, ((0, 0), (0, rloc - keep)))
+            return u_scaled, err_sq[None], norm_sq[None]
         u, s, _ = jnp.linalg.svd(a_blk, full_matrices=False)
         k = s.shape[0]
         keep = min(rloc, k)
@@ -68,14 +116,21 @@ def _local_svd_fn(mesh, axis_name: str, lrows: int, lcols: int, rloc: int, jdtyp
         if keep < rloc:
             u_scaled = jnp.pad(u_scaled, ((0, 0), (0, rloc - keep)))
         err_sq = jnp.sum(s[keep:] ** 2)
-        return u_scaled, err_sq[None]  # singleton axis so shards concatenate
+        # Frobenius partial fused into the same data read (the a-posteriori
+        # bound needs ‖A‖_F; a separate eager pass would re-stream A)
+        norm_sq = jnp.sum(s * s)
+        return u_scaled, err_sq[None], norm_sq[None]
 
     return jax.jit(
         jax.shard_map(
             kernel,
             mesh=mesh,
             in_specs=PartitionSpec(None, axis_name),
-            out_specs=(PartitionSpec(None, axis_name), PartitionSpec(axis_name)),
+            out_specs=(
+                PartitionSpec(None, axis_name),
+                PartitionSpec(axis_name),
+                PartitionSpec(axis_name),
+            ),
             check_vma=False,
         )
     )
@@ -207,45 +262,74 @@ def _hsvd_impl(
     compute_sv: bool,
     silent: bool,
 ):
-    from . import basics
-
     comm: MeshCommunication = A.comm
     dtype = A.dtype
     if types.heat_type_is_exact(dtype):
         dtype = types.float32
     jt = dtype.jax_type()
 
-    # orient split=1 (columns distributed) — reference svdtools.py:314-318
-    transposed = False
-    work = A
-    if A.split == 0:
-        work = basics.transpose(A, None)
-        transposed = True
-
-    m, n = work.shape
+    # orient split=1 (columns distributed) — reference svdtools.py:314-318.
+    # A split=0 array is NOT resharded: its physical row shards ARE the
+    # column shards of Aᵀ (P('d',None) → transpose → P(None,'d')), so the
+    # orientation is a device-local relabel with no collective and no
+    # unpad/repad round trip.
+    transposed = A.split == 0
+    m, n = (A.shape[1], A.shape[0]) if transposed else A.shape
     full_rank_cap = min(m, n)
 
-    # Frobenius norm for the relative error estimate
-    a_norm = float(jnp.linalg.norm(work.larray.astype(jt)))
-
-    if work.split is None or not comm.is_distributed():
-        # single-device path: plain truncated SVD
-        u, s, vt = safe_svd(work.larray.astype(jt), full_matrices=False)
-        err_sq = 0.0
-        r_final = _choose_rank(np.asarray(s), maxrank, rtol, a_norm, err_sq, full_rank_cap)
-        U_arr = DNDarray(u[:, :r_final], (m, r_final), dtype, None, A.device, comm)
-        s_np = s[:r_final]
-        err = float(np.sqrt(np.sum(np.asarray(s[r_final:]) ** 2))) / max(a_norm, 1e-30)
+    if A.split is None or not comm.is_distributed():
+        # single-device path
+        arr = A.larray.astype(jt)
+        if transposed:
+            arr = arr.T
+        budget = (maxrank + safetyshift) if maxrank is not None else None
+        sketch_l = None
+        if budget is not None:
+            l = min(budget + _SKETCH_OVERSAMPLE, full_rank_cap)
+            if 4 * l <= full_rank_cap:
+                sketch_l = l
+        if sketch_l is not None:
+            # small rank budget: randomized range finder, O(mnl) not O(mn²)
+            keep = min(budget, full_rank_cap)
+            with svd_x32_scope(jt):
+                u, s_dev, err0_sq_dev, norm_sq_dev = _sketched_single_fn(keep, sketch_l)(arr)
+            err0_sq = float(err0_sq_dev)
+            a_norm = float(np.sqrt(max(float(norm_sq_dev), 0.0)))
+            s = np.asarray(jax.device_get(s_dev))
+            r_final = _choose_rank(s, maxrank, rtol, a_norm, err0_sq, full_rank_cap)
+            U_arr = DNDarray(u[:, :r_final], (m, r_final), dtype, None, A.device, comm)
+            s_np = s[:r_final]
+            err = float(np.sqrt(err0_sq + np.sum(s[r_final:] ** 2))) / max(a_norm, 1e-30)
+        else:
+            a_norm = float(jnp.linalg.norm(arr))
+            u, s, vt = safe_svd(arr, full_matrices=False)
+            err_sq = 0.0
+            r_final = _choose_rank(np.asarray(s), maxrank, rtol, a_norm, err_sq, full_rank_cap)
+            U_arr = DNDarray(u[:, :r_final], (m, r_final), dtype, None, A.device, comm)
+            s_np = s[:r_final]
+            err = float(np.sqrt(np.sum(np.asarray(s[r_final:]) ** 2))) / max(a_norm, 1e-30)
     else:
         p = comm.size
         rloc = min(m, -(-n // p))
         if maxrank is not None:
             rloc = min(rloc, maxrank + safetyshift)
-        phys = work._phys.astype(jt)
+        phys = A._phys.astype(jt)
+        if transposed:
+            # pad rows become zero pad columns: Frobenius/SVD-neutral
+            phys = phys.T
         lcols = phys.shape[1] // p
-        fn = _local_svd_fn(comm.mesh, comm.axis_name, phys.shape[0], lcols, rloc, np.dtype(jt).name)
+        sketch_l = None
+        if maxrank is not None:
+            lmin = min(phys.shape[0], lcols)
+            l = min(rloc + _SKETCH_OVERSAMPLE, lmin)
+            if 4 * l <= lmin:
+                sketch_l = l
+        fn = _local_svd_fn(
+            comm.mesh, comm.axis_name, phys.shape[0], lcols, rloc, np.dtype(jt).name, sketch_l
+        )
         with svd_x32_scope(jt):
-            b_phys, err_blocks = fn(phys)
+            b_phys, err_blocks, normsq_blocks = fn(phys)
+        a_norm = float(np.sqrt(max(float(jnp.sum(normsq_blocks)), 0.0)))
         level_err_sq = float(jnp.sum(err_blocks))
         B = DNDarray(
             b_phys, (m, int(b_phys.shape[1])), dtype, 1, A.device, comm

@@ -125,7 +125,6 @@ def broadcast_to(x: DNDarray, shape: Tuple[int, ...]) -> DNDarray:
 def collect(arr: DNDarray, target_rank: int = 0) -> DNDarray:
     """Gather the whole array onto one device (reference: manipulations.py
     collect / dndarray.collect_)."""
-    out = arr.copy() if hasattr(arr, "copy") else arr
     out = arr.__copy__()
     out.collect_(target_rank)
     return out

@@ -233,3 +233,51 @@ class TestVisionTransforms:
     def test_unknown_transform_raises(self):
         with pytest.raises(AttributeError):
             vision_transforms.RandomCrop
+
+
+class TestCommSplit:
+    """MPI-faithful Comm.Split semantics (single-controller adaptation)."""
+
+    def test_scalar_color_dups(self):
+        import heat_tpu as ht
+
+        comm = ht.get_comm()
+        dup = comm.Split(0)
+        assert dup.size == comm.size
+
+    def test_vector_color_groups(self):
+        import heat_tpu as ht
+
+        comm = ht.get_comm()
+        p = comm.size
+        if p < 2:
+            return
+        colors = [i % 2 for i in range(p)]
+        groups = comm.Split(colors)
+        assert set(groups) == {0, 1}
+        assert groups[0].size == (p + 1) // 2
+        assert groups[1].size == p // 2
+        # key-ordered membership (reverse order within group 0)
+        keys = list(range(p, 0, -1))
+        rev = comm.Split(colors, keys)
+        assert [d for d in rev[0].devices] == list(reversed([d for d in groups[0].devices]))
+
+    def test_negative_color_excluded(self):
+        import heat_tpu as ht
+
+        comm = ht.get_comm()
+        p = comm.size
+        colors = [-1] + [0] * (p - 1)
+        groups = comm.Split(colors)
+        assert groups[0].size == p - 1
+
+    def test_bad_lengths_raise(self):
+        import heat_tpu as ht
+        import pytest as _pytest
+
+        comm = ht.get_comm()
+        with _pytest.raises(ValueError):
+            comm.Split([0])
+        if comm.size > 1:
+            with _pytest.raises(ValueError):
+                comm.Split([0] * comm.size, [0])

@@ -344,3 +344,16 @@ class TestReviewRegressions:
         x = np.tile(np.arange(3, dtype=np.float32), 5 * P)
         sv = manipulations._sorted_values(ht.array(x, split=0), 0)
         np.testing.assert_array_equal(sv.numpy(), np.sort(x))
+
+    def test_binary_mismatched_split_broadcast(self):
+        # operand split maps to a non-dominant output axis and cannot be
+        # resplit: must feed the logical view, not the padded physical
+        a = ht.array(np.ones((6, 5), dtype=np.float32), split=0)
+        b = ht.array(np.arange(5.0, dtype=np.float32), split=0)
+        np.testing.assert_allclose((a + b).numpy(), np.ones((6, 5)) + np.arange(5.0))
+
+    def test_binary_extent1_split_operand(self):
+        c = ht.array(np.array([3.0], dtype=np.float32), split=0)
+        r = c + 1.0
+        assert r.numpy().shape == (1,)
+        assert float(r.numpy()[0]) == 4.0
