@@ -63,11 +63,39 @@ def _kw_key(kwargs: Optional[dict]):
         return None
 
 
-def _mask_tail(arr: jax.Array, split: int, n: int, fill=0) -> jax.Array:
-    """Fill positions >= n along ``split`` (the pad region) with ``fill``
-    — traceable (fuses into the surrounding program)."""
-    iota = jax.lax.broadcasted_iota(jnp.int32, arr.shape, split)
-    return jnp.where(iota < n, arr, jnp.asarray(fill, dtype=arr.dtype))
+def _kw_split(kwargs: Optional[dict]):
+    """Partition kwargs into (static_items, dyn_names, dyn_dtypes) +
+    dyn_values. Float/complex scalars and arrays become TRACED arguments —
+    baking them into the program cache key would recompile per value
+    (e.g. ``ht.clip(x, max=hi)`` in a loop) and leak dead executables.
+    Ints/bools/strings stay static: jnp ops require them at trace time
+    (axis, decimals, mode). Returns None when uncacheable."""
+    static = []
+    dyn_names = []
+    dyn_vals = []
+    try:
+        for k in sorted(kwargs or {}):
+            v = kwargs[k]
+            if v is None or isinstance(v, (bool, int, str, bytes)):
+                static.append((k, v))
+            elif isinstance(v, (float, complex)):
+                dyn_names.append(k)
+                dyn_vals.append(v)
+            elif isinstance(v, (np.ndarray, jax.Array)):
+                dyn_names.append(k)
+                dyn_vals.append(v)
+            elif isinstance(v, tuple):
+                hash(v)
+                static.append((k, v))
+            else:
+                return None
+    except TypeError:
+        return None
+    dyn_dtypes = tuple(np.result_type(v).name for v in dyn_vals)
+    return (tuple(static), tuple(dyn_names), dyn_dtypes), tuple(dyn_vals)
+
+
+_mask_tail = _padding.mask_tail
 
 
 def _pad_operand(arr, out_ndim: int, split: int, pext: int):
@@ -146,11 +174,13 @@ def _binary_callable(op, comm, out_ndim, split, n, pext, cast, scalar1, scalar2,
 
 
 @functools.lru_cache(maxsize=4096)
-def _unary_callable(op, comm, ndim, split, n, pext, cast, kw):
-    def fn(arr):
+def _unary_callable(op, comm, ndim, split, n, pext, cast, static_kw, dyn_names):
+    def fn(arr, *dyn):
+        kwargs = dict(static_kw)
+        kwargs.update(zip(dyn_names, dyn))
         if cast is not None:
             arr = arr.astype(jnp.dtype(cast))
-        r = op(arr, **dict(kw))
+        r = op(arr, **kwargs)
         if split is not None and pext != n:
             r = _mask_tail(r, split, n)
         return r
@@ -187,16 +217,22 @@ def _cum_callable(op, comm, ndim, split, n, pext, axis, cast):
 
 
 @functools.lru_cache(maxsize=4096)
-def _local_probe_keeps_shape(op, shape, dtype, cast, kw) -> bool:
+def _local_probe_keeps_shape(op, shape, dtype, cast, static_kw, dyn_names, dyn_dtypes, dyn_shapes) -> bool:
     """True iff ``op`` maps an array of (shape, dtype[, cast]) to the same
     shape — the condition for running it on the physical array."""
-    def probe(a):
+    def probe(a, *dyn):
+        kwargs = dict(static_kw)
+        kwargs.update(zip(dyn_names, dyn))
         if cast is not None:
             a = a.astype(jnp.dtype(cast))
-        return op(a, **dict(kw))
+        return op(a, **kwargs)
 
     try:
-        res = jax.eval_shape(probe, jax.ShapeDtypeStruct(shape, jnp.dtype(dtype)))
+        structs = [
+            jax.ShapeDtypeStruct(sh, jnp.dtype(dt))
+            for sh, dt in zip(dyn_shapes, dyn_dtypes)
+        ]
+        res = jax.eval_shape(probe, jax.ShapeDtypeStruct(shape, jnp.dtype(dtype)), *structs)
     except Exception:
         return False
     return hasattr(res, "shape") and tuple(res.shape) == tuple(shape)
@@ -406,20 +442,23 @@ def __local_op(
         promoted = types.promote_types(x.dtype, types.float32)
         cast = np.dtype(promoted.jax_type()).name
 
-    kw = _kw_key(kwargs)
-    if kw is None:
+    ks = _kw_split(kwargs)
+    if ks is None:
         # uncacheable kwargs: eager logical path
         return _local_op_eager(operation, x, out, cast, **kwargs)
+    (static_kw, dyn_names, dyn_dtypes), dyn_vals = ks
 
     comm = x.comm
     n, pext = _phys_meta(x)
+    dyn_shapes = tuple(tuple(np.shape(v)) for v in dyn_vals)
     if not _local_probe_keeps_shape(
-        operation, tuple(x._phys.shape), np.dtype(x._phys.dtype).name, cast, kw
+        operation, tuple(x._phys.shape), np.dtype(x._phys.dtype).name, cast,
+        static_kw, dyn_names, dyn_dtypes, dyn_shapes,
     ):
         return _local_op_eager(operation, x, out, cast, **kwargs)
 
-    prog = _unary_callable(operation, comm, x.ndim, x.split, n, pext, cast, kw)
-    result = prog(x._phys)
+    prog = _unary_callable(operation, comm, x.ndim, x.split, n, pext, cast, static_kw, dyn_names)
+    result = prog(x._phys, *dyn_vals)
     res_type = types.canonical_heat_type(result.dtype)
 
     if out is not None:
@@ -548,3 +587,11 @@ def __reduce_op(
             out.larray = _padding.unpad(result, output_shape, output_split).astype(out.dtype.jax_type())
         return out
     return DNDarray(result, output_shape, res_type, output_split, x.device, comm)
+
+from .communication import register_mesh_cache
+
+# entries bake mesh geometry: cleared when init_distributed rebuilds the world
+register_mesh_cache(_binary_callable)
+register_mesh_cache(_unary_callable)
+register_mesh_cache(_reduce_callable)
+register_mesh_cache(_cum_callable)

@@ -29,6 +29,7 @@ __all__ = [
     "pad_logical",
     "unpad",
     "mask_phys",
+    "mask_tail",
     "valid_mask",
 ]
 
@@ -88,7 +89,15 @@ def valid_mask(phys: jax.Array, gshape: Tuple[int, ...], split: Optional[int]) -
 def mask_phys(phys: jax.Array, gshape: Tuple[int, ...], split: Optional[int], fill=0) -> jax.Array:
     """Overwrite the pad region with ``fill`` (restores the zero-pad
     invariant, or installs a reduction-neutral element)."""
-    mask = valid_mask(phys, gshape, split)
-    if mask is None:
+    if split is None or phys.shape[split] == gshape[split]:
         return phys
-    return jnp.where(mask, phys, jnp.asarray(fill, dtype=phys.dtype))
+    return mask_tail(phys, split, gshape[split], fill)
+
+
+def mask_tail(arr: jax.Array, split: int, n: int, fill=0) -> jax.Array:
+    """Fill positions >= ``n`` along ``split`` (the pad region) with
+    ``fill`` — traceable, fuses into a surrounding jitted program. The
+    n-based core of ``mask_phys`` for callers that track the logical
+    extent directly."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, arr.shape, split)
+    return jnp.where(iota < n, arr, jnp.asarray(fill, dtype=arr.dtype))

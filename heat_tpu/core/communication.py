@@ -267,6 +267,24 @@ class MeshCommunication(Communication):
 MPICommunication = MeshCommunication
 
 
+# lru-cached program builders whose entries bake mesh geometry in
+# (out_shardings, shard_map meshes, comm identity). A world rebuild
+# (init_distributed) must clear them or pre-init configurations would
+# silently reuse programs placed on the defunct single-host mesh.
+_MESH_KEYED_CACHES = []
+
+
+def register_mesh_cache(cached_fn) -> None:
+    """Register a functools.lru_cache-wrapped program builder keyed (in
+    part) on a mesh/comm; cleared when the world communicator changes."""
+    _MESH_KEYED_CACHES.append(cached_fn)
+
+
+def _clear_mesh_caches() -> None:
+    for fn in _MESH_KEYED_CACHES:
+        fn.cache_clear()
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -307,6 +325,9 @@ def init_distributed(
     # new global device set — rebinding the module global would leave them
     # pointing at the stale single-host world
     MPI_WORLD.__init__()
+    # compiled programs built before init baked the old mesh into their
+    # out_shardings / shard_map meshes — drop them
+    _clear_mesh_caches()
 
     global __default_comm
     __default_comm = MPI_WORLD

@@ -482,3 +482,8 @@ def from_partition_dict(parted: dict, comm: Optional[Communication] = None) -> D
         sl = tuple(slice(st, st + sh) for st, sh in zip(start, data.shape))
         out[sl] = data
     return array(out, split=split, comm=comm)
+
+from .communication import register_mesh_cache
+
+# entries bake mesh geometry: cleared when init_distributed rebuilds the world
+register_mesh_cache(_cached_creator)

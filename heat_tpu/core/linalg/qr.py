@@ -166,3 +166,8 @@ def qr(
 
 
 DNDarray.qr = qr
+
+from ..communication import register_mesh_cache
+
+# entries bake mesh geometry: cleared when init_distributed rebuilds the world
+register_mesh_cache(_tsqr_fn)

@@ -91,6 +91,23 @@ def _sketched_single_fn(keep: int, sketch_l: int):
 
 
 @functools.lru_cache(maxsize=128)
+def _sketched_single_rank_fn(keep: int, sketch_l: int, r_final: int):
+    """Rank-budget variant: truncation and the a-posteriori error fold
+    into the SAME compiled program, so one call is ONE dispatch — every
+    eager op costs ~4 ms over the remote-execution tunnel and a blocking
+    read ~90 ms, so op count, not FLOPs, dominates this call."""
+
+    def run(arr):
+        u, s, err_sq, norm_sq = _sketched_uds(arr, keep, sketch_l)
+        err = jnp.sqrt(err_sq + jnp.sum(s[r_final:] ** 2)) / jnp.maximum(
+            jnp.sqrt(norm_sq), 1e-30
+        )
+        return u[:, :r_final], s[:r_final], err
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=128)
 def _local_svd_fn(
     mesh, axis_name: str, lrows: int, lcols: int, rloc: int, jdtype: str,
     sketch_l: Optional[int] = None,
@@ -133,6 +150,24 @@ def _local_svd_fn(
             ),
             check_vma=False,
         )
+    )
+
+
+
+def _err_scalar(val, A: DNDarray) -> DNDarray:
+    """Wrap the relative-error estimate as a 0-d replicated DNDarray — the
+    reference returns a DNDarray too (svdtools.py:449), and keeping it lazy
+    avoids a ~90 ms host read-back per call over the execution tunnel."""
+    arr = jnp.asarray(val)
+    if types.heat_type_is_exact(types.canonical_heat_type(arr.dtype)):
+        arr = arr.astype(jnp.float32)
+    return DNDarray(
+        jax.device_put(arr, A.comm.sharding(0, None)),
+        (),
+        types.canonical_heat_type(arr.dtype),
+        None,
+        A.device,
+        A.comm,
     )
 
 
@@ -291,23 +326,45 @@ def _hsvd_impl(
         if sketch_l is not None:
             # small rank budget: randomized range finder, O(mnl) not O(mn²)
             keep = min(budget, full_rank_cap)
-            with svd_x32_scope(jt):
-                u, s_dev, err0_sq_dev, norm_sq_dev = _sketched_single_fn(keep, sketch_l)(arr)
-            err0_sq = float(err0_sq_dev)
-            a_norm = float(np.sqrt(max(float(norm_sq_dev), 0.0)))
-            s = np.asarray(jax.device_get(s_dev))
-            r_final = _choose_rank(s, maxrank, rtol, a_norm, err0_sq, full_rank_cap)
-            U_arr = DNDarray(u[:, :r_final], (m, r_final), dtype, None, A.device, comm)
-            s_np = s[:r_final]
-            err = float(np.sqrt(err0_sq + np.sum(s[r_final:] ** 2))) / max(a_norm, 1e-30)
+            if rtol is not None:
+                with svd_x32_scope(jt):
+                    u, s_dev, err0_sq_dev, norm_sq_dev = _sketched_single_fn(keep, sketch_l)(arr)
+            # host transfers over the execution tunnel cost ~90 ms EACH —
+            # rank-budget mode needs no spectrum on host (rank is static),
+            # so truncation + error fold into the jitted program (one
+            # dispatch) and err stays a lazy 0-d DNDarray
+            if rtol is None:
+                r_final = max(1, min(maxrank, keep))
+                with svd_x32_scope(jt):
+                    u_t, s_t, err_dev = _sketched_single_rank_fn(keep, sketch_l, r_final)(arr)
+                err = _err_scalar(err_dev, A)
+                U_arr = DNDarray(u_t, (m, r_final), dtype, None, A.device, comm)
+                s_np = s_t
+            else:
+                s_host, err0_sq, norm_sq = jax.device_get((s_dev, err0_sq_dev, norm_sq_dev))
+                a_norm = float(np.sqrt(max(float(norm_sq), 0.0)))
+                r_final = _choose_rank(
+                    np.asarray(s_host), maxrank, rtol, a_norm, float(err0_sq), full_rank_cap
+                )
+                err = _err_scalar(
+                    float(np.sqrt(float(err0_sq) + np.sum(np.asarray(s_host)[r_final:] ** 2)))
+                    / max(a_norm, 1e-30),
+                    A,
+                )
+                U_arr = DNDarray(u[:, :r_final], (m, r_final), dtype, None, A.device, comm)
+                s_np = s_dev[:r_final]
         else:
-            a_norm = float(jnp.linalg.norm(arr))
             u, s, vt = safe_svd(arr, full_matrices=False)
+            # one combined transfer for norm + spectrum
+            s_host = np.asarray(jax.device_get(s))
+            a_norm = float(np.sqrt(np.sum(s_host.astype(np.float64) ** 2)))
             err_sq = 0.0
-            r_final = _choose_rank(np.asarray(s), maxrank, rtol, a_norm, err_sq, full_rank_cap)
+            r_final = _choose_rank(s_host, maxrank, rtol, a_norm, err_sq, full_rank_cap)
             U_arr = DNDarray(u[:, :r_final], (m, r_final), dtype, None, A.device, comm)
             s_np = s[:r_final]
-            err = float(np.sqrt(np.sum(np.asarray(s[r_final:]) ** 2))) / max(a_norm, 1e-30)
+            err = _err_scalar(
+                float(np.sqrt(np.sum(s_host[r_final:] ** 2))) / max(a_norm, 1e-30), A
+            )
     else:
         p = comm.size
         rloc = min(m, -(-n // p))
@@ -329,24 +386,39 @@ def _hsvd_impl(
         )
         with svd_x32_scope(jt):
             b_phys, err_blocks, normsq_blocks = fn(phys)
-        a_norm = float(np.sqrt(max(float(jnp.sum(normsq_blocks)), 0.0)))
-        level_err_sq = float(jnp.sum(err_blocks))
         B = DNDarray(
             b_phys, (m, int(b_phys.shape[1])), dtype, 1, A.device, comm
         )
         U_merged, s_all = _merge_svd(B, calc_u=True)
-        s_np_all = np.asarray(s_all)
-        r_final = _choose_rank(s_np_all, maxrank, rtol, a_norm, level_err_sq, full_rank_cap)
-        merge_err_sq = float(np.sum(s_np_all[r_final:] ** 2))
-        err = float(np.sqrt(level_err_sq + merge_err_sq)) / max(a_norm, 1e-30)
+        if rtol is None:
+            # static rank: err computed on device, ONE scalar read-back
+            r_final = max(1, min(maxrank, min(int(s_all.shape[0]), full_rank_cap)))
+            err = _err_scalar(
+                jnp.sqrt(jnp.sum(err_blocks) + jnp.sum(s_all[r_final:] ** 2))
+                / jnp.maximum(jnp.sqrt(jnp.sum(normsq_blocks)), 1e-30),
+                A,
+            )
+        else:
+            s_np_all, lvl_sq, nrm_sq = jax.device_get(
+                (s_all, jnp.sum(err_blocks), jnp.sum(normsq_blocks))
+            )
+            s_np_all = np.asarray(s_np_all)
+            a_norm = float(np.sqrt(max(float(nrm_sq), 0.0)))
+            level_err_sq = float(lvl_sq)
+            r_final = _choose_rank(s_np_all, maxrank, rtol, a_norm, level_err_sq, full_rank_cap)
+            merge_err_sq = float(np.sum(s_np_all[r_final:] ** 2))
+            err = _err_scalar(
+                float(np.sqrt(level_err_sq + merge_err_sq)) / max(a_norm, 1e-30), A
+            )
         # truncate U to the final rank
         u_trunc = U_merged.larray[:, :r_final]
         U_arr = DNDarray(comm.shard(u_trunc, 0), (m, r_final), dtype, 0, A.device, comm)
         s_np = s_all[:r_final]
 
+    sigma_arr = jnp.asarray(s_np)
     sigma = DNDarray(
-        jax.device_put(jnp.asarray(s_np), comm.sharding(1, None)),
-        (int(np.asarray(s_np).shape[0]),),
+        jax.device_put(sigma_arr, comm.sharding(1, None)),
+        (int(sigma_arr.shape[0]),),
         dtype,
         None,
         A.device,
@@ -425,3 +497,8 @@ def _choose_rank(
     if maxrank is not None:
         r = min(r, maxrank)
     return max(1, r)
+
+from ..communication import register_mesh_cache
+
+# entries bake mesh geometry: cleared when init_distributed rebuilds the world
+register_mesh_cache(_local_svd_fn)

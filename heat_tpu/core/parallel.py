@@ -347,3 +347,11 @@ def ring_pairwise(
         computed = ((col_blk - row_blk) % p) < steps
         out = jnp.where(computed, out, out.T)
     return out
+
+from .communication import register_mesh_cache
+
+# entries bake mesh geometry: cleared when init_distributed rebuilds the world
+register_mesh_cache(_halo_program)
+register_mesh_cache(_ring_program)
+register_mesh_cache(_oddeven_sort_program)
+register_mesh_cache(_oddeven_sort_values_program)

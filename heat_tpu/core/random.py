@@ -314,3 +314,8 @@ def standard_normal(shape=None, dtype=types.float32, split=None, device=None, co
         shape = (1,)
     shape = sanitize_shape(shape)
     return randn(*shape, dtype=dtype, split=split, device=device, comm=comm)
+
+from .communication import register_mesh_cache
+
+# entries bake mesh geometry: cleared when init_distributed rebuilds the world
+register_mesh_cache(_cached_sampler)

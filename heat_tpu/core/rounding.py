@@ -102,11 +102,16 @@ def sgn(x: DNDarray, out=None) -> DNDarray:
     return _operations.__local_op(jnp.sign, x, out, no_cast=True)
 
 
+def _sign_complex(a):
+    # module-level: a per-call lambda would defeat the cached-jit layer
+    return jnp.sign(jnp.real(a)).astype(a.dtype)
+
+
 def sign(x: DNDarray, out=None) -> DNDarray:
     """Elementwise sign; for complex input the sign of the real part
     (reference: rounding.py sign follows numpy)."""
     if types.heat_type_is_complexfloating(x.dtype):
-        return _operations.__local_op(lambda a: jnp.sign(jnp.real(a)).astype(a.dtype), x, out, no_cast=True)
+        return _operations.__local_op(_sign_complex, x, out, no_cast=True)
     return _operations.__local_op(jnp.sign, x, out, no_cast=True)
 
 

@@ -115,3 +115,8 @@ def convolve(a: DNDarray, v: DNDarray, mode: str = "full") -> DNDarray:
     return DNDarray(
         result, gshape, types.canonical_heat_type(result.dtype), split, a.device, a.comm
     )
+
+from .communication import register_mesh_cache
+
+# entries bake mesh geometry: cleared when init_distributed rebuilds the world
+register_mesh_cache(_conv_program)

@@ -49,13 +49,22 @@ __all__ = [
 ]
 
 
+def _argmax_i64(a, axis=None, keepdims=False):
+    # module-level (NOT a per-call lambda): the cached-jit layer keys
+    # programs on op identity, so a fresh callable per call would
+    # retrace+recompile every invocation
+    return jnp.argmax(a, axis=axis, keepdims=keepdims).astype(jnp.int64)
+
+
+def _argmin_i64(a, axis=None, keepdims=False):
+    return jnp.argmin(a, axis=axis, keepdims=keepdims).astype(jnp.int64)
+
+
 def argmax(x: DNDarray, axis: Optional[int] = None, out=None, **kwargs) -> DNDarray:
     """Indices of maximum values (reference: statistics.py argmax — MPI
     value∥index custom op; here a sharded jnp.argmax)."""
     return _operations.__reduce_op(
-        lambda a, axis=None, keepdims=False: jnp.argmax(a, axis=axis, keepdims=keepdims).astype(
-            jnp.int64
-        ),
+        _argmax_i64,
         x,
         axis=axis,
         out=out,
@@ -66,9 +75,7 @@ def argmax(x: DNDarray, axis: Optional[int] = None, out=None, **kwargs) -> DNDar
 def argmin(x: DNDarray, axis: Optional[int] = None, out=None, **kwargs) -> DNDarray:
     """Indices of minimum values."""
     return _operations.__reduce_op(
-        lambda a, axis=None, keepdims=False: jnp.argmin(a, axis=axis, keepdims=keepdims).astype(
-            jnp.int64
-        ),
+        _argmin_i64,
         x,
         axis=axis,
         out=out,

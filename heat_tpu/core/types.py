@@ -465,28 +465,31 @@ def result_type(*arrays_and_types: Any) -> Type[datatype]:
     return canonical_heat_type(jnp.result_type(*(_to_jax_operand(o) for o in arrays_and_types)))
 
 
+def _iscomplex_local(a):
+    # module-level: per-call closures would defeat the cached-jit layer
+    if jnp.iscomplexobj(a):
+        return jnp.imag(a) != 0
+    return jnp.zeros(a.shape, dtype=jnp.bool_)
+
+
+def _isreal_local(a):
+    if jnp.iscomplexobj(a):
+        return jnp.imag(a) == 0
+    return jnp.ones(a.shape, dtype=jnp.bool_)
+
+
 def iscomplex(x):
     """Elementwise test for non-zero imaginary part (reference: complex_math)."""
     from . import _operations
 
-    def _local(a):
-        if jnp.iscomplexobj(a):
-            return jnp.imag(a) != 0
-        return jnp.zeros(a.shape, dtype=jnp.bool_)
-
-    return _operations.__local_op(_local, x, None, no_cast=True)
+    return _operations.__local_op(_iscomplex_local, x, None, no_cast=True)
 
 
 def isreal(x):
     """Elementwise test for zero imaginary part."""
     from . import _operations
 
-    def _local(a):
-        if jnp.iscomplexobj(a):
-            return jnp.imag(a) == 0
-        return jnp.ones(a.shape, dtype=jnp.bool_)
-
-    return _operations.__local_op(_local, x, None, no_cast=True)
+    return _operations.__local_op(_isreal_local, x, None, no_cast=True)
 
 
 class finfo:

@@ -224,3 +224,8 @@ class DataLoader:
                 yield ds[start:stop]
         else:  # custom iterable dataset (e.g. PartialH5Dataset)
             yield from ds
+
+from ...core.communication import register_mesh_cache
+
+# entries bake mesh geometry: cleared when init_distributed rebuilds the world
+register_mesh_cache(_cached_permute)
