@@ -658,20 +658,80 @@ class DNDarray:
             out_split = None
         return DNDarray(result, gshape, self.__dtype, out_split, self.__device, self.__comm)
 
+    def __normalize_basic_key(self, key):
+        """Resolve an int/slice/Ellipsis key against the LOGICAL shape, or
+        None when the key is advanced (arrays, masks, newaxis). Explicit
+        bounds matter: a bare ``slice(None)`` on the split dim would span
+        the physical pad region."""
+        keys = key if isinstance(key, tuple) else (key,)
+        # bool is an int subclass but numpy gives it broadcast (not index)
+        # semantics — route it to the advanced path
+        if any(
+            isinstance(k, (bool, np.bool_))
+            or not (k is Ellipsis or isinstance(k, (int, np.integer, slice)))
+            for k in keys
+        ):
+            return None
+        n_explicit = sum(1 for k in keys if k is not Ellipsis)
+        out = []
+        dim = 0
+        for k in keys:
+            if k is Ellipsis:
+                for _ in range(self.ndim - n_explicit):
+                    out.append(slice(0, self.__gshape[dim], 1))
+                    dim += 1
+                continue
+            if isinstance(k, (int, np.integer)):
+                k = int(k)
+                if k < 0:
+                    k += self.__gshape[dim]
+                if not 0 <= k < self.__gshape[dim]:
+                    raise IndexError(
+                        f"index {k} out of bounds for axis {dim} with size {self.__gshape[dim]}"
+                    )
+                out.append(k)
+            else:
+                start, stop, step = k.indices(self.__gshape[dim])
+                if step < 0 and stop < 0:
+                    # slice.indices yields stop=-1 for "past the front";
+                    # jax would reinterpret that as size-1 — use None
+                    out.append(slice(start, None, step))
+                else:
+                    out.append(slice(start, stop, step))
+            dim += 1
+        while dim < self.ndim:
+            out.append(slice(0, self.__gshape[dim], 1))
+            dim += 1
+        if dim != self.ndim:
+            return None
+        return tuple(out)
+
     def __setitem__(self, key, value) -> None:
         """Global assignment (reference dndarray.py:1537). Rebinds the
-        functional update ``at[key].set`` under the original sharding."""
+        functional update ``at[key].set`` under the original sharding.
+
+        Basic keys (ints/slices) scatter directly on the PHYSICAL array —
+        one fused update preserving the sharding, no unpad/repad round
+        trip (normalized bounds keep the pad region untouched). Advanced
+        keys fall back to the logical path.
+        """
         if isinstance(key, LocalIndex):
             self.__array = self.__array.at[key.obj].set(jnp.asarray(value))
             self._invalidate_caches()
             return
+        if isinstance(value, DNDarray):
+            value = value.larray
+        value = jnp.asarray(value, dtype=self.__dtype.jax_type()) if not isinstance(value, jax.Array) else value.astype(self.__dtype.jax_type())
+        if not isinstance(key, (DNDarray, jax.Array, np.ndarray)):
+            basic = self.__normalize_basic_key(key)
+            if basic is not None:
+                self.__array = self.__array.at[basic].set(value)
+                self._invalidate_caches()
+                return
         if isinstance(key, DNDarray):
             key = key.larray
         elif isinstance(key, tuple):
             key = tuple(k.larray if isinstance(k, DNDarray) else k for k in key)
-        if isinstance(value, DNDarray):
-            value = value.larray
-        value = jnp.asarray(value, dtype=self.__dtype.jax_type()) if not isinstance(value, jax.Array) else value.astype(self.__dtype.jax_type())
         new = self.larray.at[key].set(value)
         self.__array = self.__comm.shard(new, self.__split)
         self._invalidate_caches()

@@ -219,17 +219,46 @@ if __NETCDF:
             data = np.asarray(handle.variables[variable][...])
         return _from_numpy(data, types.canonical_heat_type(dtype), split, device, comm)
 
-    def save_netcdf(data, path, variable, mode="w", **kwargs):
-        """Save a DNDarray to netCDF (reference: io.py:366)."""
+    def save_netcdf(
+        data,
+        path,
+        variable,
+        mode="w",
+        dimension_names=None,
+        is_unlimited=False,
+        file_slices=slice(None),
+        **kwargs,
+    ):
+        """Save a DNDarray to netCDF (reference: io.py:366).
+
+        ``mode``: 'w' truncates, 'a'/'r+' opens for update. Appending
+        along a dimension (the reference's time-series pattern) works by
+        creating the variable once with ``is_unlimited=True`` and then
+        writing subsequent steps with ``mode='r+'`` and ``file_slices``
+        addressing the new region, e.g. ``file_slices=slice(t, t+1)``.
+        """
+        if mode not in ("w", "a", "r+"):
+            raise ValueError(f"mode must be one of 'w', 'a', 'r+', got {mode!r}")
+        arr = data.numpy()
+        if dimension_names is None:
+            dims = [f"{variable}_dim{i}" for i in range(arr.ndim)]
+        elif isinstance(dimension_names, str):
+            dims = [dimension_names]
+        else:
+            dims = list(dimension_names)
+        if len(dims) != arr.ndim:
+            raise ValueError(
+                f"{len(dims)} dimension names given for {arr.ndim} dimensions"
+            )
         with netCDF4.Dataset(path, mode) as handle:
-            arr = data.numpy()
-            dims = []
-            for i, s in enumerate(arr.shape):
-                name = f"{variable}_dim{i}"
-                handle.createDimension(name, s)
-                dims.append(name)
-            var = handle.createVariable(variable, arr.dtype, tuple(dims))
-            var[...] = arr
+            for i, name in enumerate(dims):
+                if name not in handle.dimensions:
+                    handle.createDimension(name, None if is_unlimited else arr.shape[i])
+            if variable in handle.variables:
+                var = handle.variables[variable]
+            else:
+                var = handle.createVariable(variable, arr.dtype, tuple(dims), **kwargs)
+            var[file_slices] = arr
 
 
 def load_csv(

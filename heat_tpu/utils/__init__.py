@@ -1,4 +1,8 @@
-"""Utilities (reference: /root/reference/heat/utils/)."""
+"""Utilities (reference: /root/reference/heat/utils/). ``checkpoint`` is a
+TPU-native addition: sharding-aware training-state persistence (the
+reference has no model checkpointing — SURVEY §5)."""
 
+from . import checkpoint
 from . import data
 from . import vision_transforms
+from .checkpoint import load_checkpoint, save_checkpoint

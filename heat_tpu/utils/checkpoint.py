@@ -1,0 +1,118 @@
+"""Checkpoint / resume for model and optimizer state.
+
+The reference ships NO model checkpointing (SURVEY §5: persistence is
+``ht.save``/``ht.load`` to HDF5/netCDF, and the only optimizer-state
+capture is ``DetectMetricPlateau.get_state/set_state``,
+reference optim/utils.py:72/89). A TPU framework needs a real story:
+training state is a pytree of sharded arrays, and a checkpoint must be
+written per-host in parallel without gathering onto one controller.
+
+This wraps orbax — the TPU-ecosystem checkpointer — with DNDarray
+awareness: DNDarrays are decomposed into their physical arrays plus
+(gshape, split, dtype) metadata; orbax persists the arrays (sharded
+arrays are written shard-parallel on multi-host meshes) and restore
+rebinds DNDarrays on the current world communicator.
+
+Works on arbitrary pytrees: ``{"model": params, "opt": opt_state}``,
+lists, nested dicts, plain jax arrays, numpy, scalars, DNDarrays.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+
+from typing import Any, Optional
+
+from ..core import types
+from ..core.communication import sanitize_comm
+from ..core.devices import sanitize_device
+from ..core.dndarray import DNDarray
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+_DND_KEY = "__heat_dndarray__"
+
+
+def _encode(obj):
+    """Recursively decompose DNDarrays into orbax-storable leaves."""
+    if isinstance(obj, dict) and (_DND_KEY in obj or "__tuple__" in obj):
+        raise ValueError(
+            f"dict keys {_DND_KEY!r} and '__tuple__' are reserved by the "
+            "checkpoint encoding"
+        )
+    if isinstance(obj, DNDarray):
+        return {
+            _DND_KEY: True,
+            "data": obj._phys,
+            "gshape": list(obj.gshape),
+            "split": -1 if obj.split is None else int(obj.split),
+            "dtype": np.dtype(obj.dtype.jax_type()).name,
+        }
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        enc = [_encode(v) for v in obj]
+        return enc if isinstance(obj, list) else {"__tuple__": enc}
+    return obj
+
+
+def _decode(obj, comm, device):
+    if isinstance(obj, dict):
+        if obj.get(_DND_KEY):
+            split = None if int(obj["split"]) < 0 else int(obj["split"])
+            gshape = tuple(int(s) for s in obj["gshape"])
+            data = obj["data"]
+            # ALWAYS rebind to the current communicator: orbax restores
+            # with the sharding (and pad extent) recorded at save time,
+            # which may belong to a different mesh/topology — strip the
+            # old pad against the recorded logical shape, then reshard
+            from ..core import _padding
+
+            logical = _padding.unpad(jax.numpy.asarray(data), gshape, split)
+            phys = comm.shard(logical, split)
+            return DNDarray(
+                phys,
+                gshape,
+                types.canonical_heat_type(np.dtype(obj["dtype"])),
+                split,
+                device,
+                comm,
+            )
+        if "__tuple__" in obj and len(obj) == 1:
+            return tuple(_decode(v, comm, device) for v in obj["__tuple__"])
+        return {k: _decode(v, comm, device) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode(v, comm, device) for v in obj]
+    return obj
+
+
+def save_checkpoint(path: str, tree: Any, overwrite: bool = True) -> None:
+    """Persist a pytree of DNDarrays / jax arrays / numpy / scalars.
+
+    On multi-host meshes orbax writes each host's shards in parallel —
+    the global array is never materialized on one controller (the
+    scale-safety requirement SURVEY §7 sets for all I/O paths).
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, _encode(tree), force=overwrite)
+
+
+def load_checkpoint(path: str, comm=None, device=None) -> Any:
+    """Restore a pytree saved by ``save_checkpoint``; DNDarrays rebind to
+    ``comm`` (default: the global world communicator), resharded to their
+    recorded split."""
+    import orbax.checkpoint as ocp
+
+    comm = sanitize_comm(comm)
+    device = sanitize_device(device)
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(path)
+    return _decode(restored, comm, device)
