@@ -673,6 +673,11 @@ class DNDarray:
         ):
             return None
         n_explicit = sum(1 for k in keys if k is not Ellipsis)
+        if n_explicit > self.ndim:
+            raise IndexError(
+                f"too many indices for array: array is {self.ndim}-dimensional, "
+                f"but {n_explicit} were indexed"
+            )
         out = []
         dim = 0
         for k in keys:
@@ -692,7 +697,12 @@ class DNDarray:
                 out.append(k)
             else:
                 start, stop, step = k.indices(self.__gshape[dim])
-                if step < 0 and stop < 0:
+                if len(range(start, stop, step)) == 0:
+                    # empty selection; also covers the clamped start=-1 a
+                    # below-range negative-step start produces, which jax
+                    # would reinterpret as "the last element"
+                    out.append(slice(0, 0, 1))
+                elif step < 0 and stop < 0:
                     # slice.indices yields stop=-1 for "past the front";
                     # jax would reinterpret that as size-1 — use None
                     out.append(slice(start, None, step))
@@ -702,8 +712,6 @@ class DNDarray:
         while dim < self.ndim:
             out.append(slice(0, self.__gshape[dim], 1))
             dim += 1
-        if dim != self.ndim:
-            return None
         return tuple(out)
 
     def __setitem__(self, key, value) -> None:

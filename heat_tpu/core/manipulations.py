@@ -585,22 +585,50 @@ def tile(x: DNDarray, reps: Sequence[int]) -> DNDarray:
 
 def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
     """k largest/smallest elements along dim; returns (values, indices)
-    (reference: manipulations.py:3981 — iterative merge across ranks; here
-    XLA top_k on the sharded array)."""
+    (reference: manipulations.py:3981 — iterative merge across ranks).
+
+    Along the split axis this runs ``parallel.distributed_topk``: local
+    per-shard top-k, all_gather of the tiny (p·k) candidate set, final
+    merge — no global gather. Off-split dims are shard-local XLA top_k.
+    """
     sanitize_in(a)
     dim = sanitize_axis(a.shape, dim)
-    arr = a.larray
-    moved = jnp.moveaxis(arr, dim, -1)
-    if largest:
-        values, indices = jax.lax.top_k(moved, k)
-    else:
-        values, indices = jax.lax.top_k(-moved, k)
-        values = -values
-    values = jnp.moveaxis(values, -1, dim)
-    indices = jnp.moveaxis(indices, -1, dim)
     split = a.split
-    vals = _wrap(values, split, a, dtype=a.dtype)
-    idx = _wrap(indices.astype(jnp.int64), split, a)
+    if (
+        split is not None
+        and dim == split
+        and a.comm.size > 1
+        and k <= a.gshape[dim]
+        and a.dtype not in (types.complex64, types.complex128)
+    ):
+        from . import _padding
+        from . import parallel
+
+        phys = a._phys
+        n = a.gshape[dim]
+        jt = a.dtype.jax_type()
+        if phys.shape[dim] != n:
+            # pads must lose: fill with the worst value for the direction
+            sentinel = _operations._resolve_neutral("min" if largest else "max", jt)
+            phys = _padding.mask_phys(phys, a.gshape, dim, fill=sentinel)
+        fv, fi = parallel.distributed_topk(phys, a.comm.mesh, a.comm.axis_name, dim, k, largest)
+        gshape = tuple(k if i == dim else s for i, s in enumerate(a.gshape))
+        vals = DNDarray(fv, gshape, a.dtype, None, a.device, a.comm)
+        idx = DNDarray(
+            fi.astype(jnp.int64), gshape, types.canonical_heat_type(jnp.int64), None, a.device, a.comm
+        )
+    else:
+        arr = a.larray
+        moved = jnp.moveaxis(arr, dim, -1)
+        if largest:
+            values, indices = jax.lax.top_k(moved, k)
+        else:
+            values, indices = jax.lax.top_k(-moved, k)
+            values = -values
+        values = jnp.moveaxis(values, -1, dim)
+        indices = jnp.moveaxis(indices, -1, dim)
+        vals = _wrap(values, split, a, dtype=a.dtype)
+        idx = _wrap(indices.astype(jnp.int64), split, a)
     if out is not None:
         if not isinstance(out, tuple) or len(out) != 2:
             raise TypeError("out must be a (values, indices) tuple of DNDarrays")

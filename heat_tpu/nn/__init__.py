@@ -58,3 +58,5 @@ def __getattr__(name):
         return getattr(_linen, name)
     except AttributeError:
         raise AttributeError(f"module 'heat_tpu.nn' has no attribute '{name}'")
+from . import attention
+from .attention import ring_attention, ring_self_attention

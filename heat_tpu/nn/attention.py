@@ -1,0 +1,172 @@
+"""Ring attention: sequence-parallel exact attention for long contexts.
+
+The reference has NO attention stack; SURVEY §5 notes its long-context
+mechanisms are exactly the ring-circulation pattern of
+``spatial/distance._dist`` (distance.py:262-359). This module is the
+TPU-native realization of that pattern for attention (Liu et al., Ring
+Attention; the flash-attention online-softmax rescaling makes each ring
+step exact): the SEQUENCE axis is sharded over the mesh, each device
+keeps its Q block stationary, and K/V blocks circulate with
+``lax.ppermute`` over ICI — per step one (Bq × Bk) attention tile rides
+the MXU while the next K/V block is in flight. Memory per device is
+O(S·d / p + Bq·Bk): no device ever holds the full S×S score matrix or
+the full K/V, so sequence length scales with the mesh.
+
+Differentiable (scan + ppermute have transpose rules), causal-maskable,
+and pad-safe: logical sequence lengths propagate through the masks so
+uneven shards never contribute.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from typing import Optional
+
+from ..core.dndarray import DNDarray
+from ..core.communication import register_mesh_cache
+from ..core import types
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_attention_program(
+    mesh: Mesh,
+    axis_name: str,
+    ndim: int,
+    seq_axis: int,
+    n_q: int,
+    n_kv: int,
+    causal: bool,
+    scale: float,
+    jdtype: str,
+):
+    """One jitted shard_map program: stationary Q block, K/V rotating the
+    ring, online-softmax (m, l, o) accumulation per step."""
+    p = mesh.devices.size
+    spec = P(*(axis_name if i == seq_axis else None for i in range(ndim)))
+    neg = jnp.finfo(jnp.dtype(jdtype)).min
+
+    def body(q, k, v):
+        r = lax.axis_index(axis_name)
+        bq = q.shape[seq_axis]
+        bk = k.shape[seq_axis]
+        # canonical layout (..., B, D): seq axis at -2 already by caller
+        q_pos = (r * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)).astype(jnp.int32)
+
+        # constant-initialized carry entries must be marked device-varying:
+        # they mix with the rotating (varying) K/V blocks inside the scan
+        o0 = jnp.zeros_like(q)  # inherits q's device-varying vma
+        m0 = jnp.full(q.shape[:-1] + (1,), neg, dtype=q.dtype)
+        l0 = jnp.zeros(q.shape[:-1] + (1,), dtype=q.dtype)
+        if p > 1:
+            m0 = lax.pcast(m0, axis_name, to="varying")
+            l0 = lax.pcast(l0, axis_name, to="varying")
+        k0, v0 = k, v
+
+        def step(carry, t):
+            k_cur, v_cur, o, m, l = carry
+            src = (r + t) % p
+            s = jnp.einsum("...qd,...kd->...qk", q, k_cur) * jnp.asarray(scale, q.dtype)
+            k_pos = (src * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)).astype(jnp.int32)
+            valid = k_pos < n_kv  # mask K/V pad rows
+            if causal:
+                valid = valid & (k_pos <= q_pos)
+            s = jnp.where(valid, s, neg)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            pexp = jnp.exp(s - m_new)
+            pexp = jnp.where(valid, pexp, 0.0)
+            corr = jnp.exp(m - m_new)
+            l = l * corr + jnp.sum(pexp, axis=-1, keepdims=True)
+            o = o * corr + jnp.einsum("...qk,...kd->...qd", pexp, v_cur)
+            perm = [((i + 1) % p, i) for i in range(p)]
+            k_nxt = lax.ppermute(k_cur, axis_name, perm) if p > 1 else k_cur
+            v_nxt = lax.ppermute(v_cur, axis_name, perm) if p > 1 else v_cur
+            return (k_nxt, v_nxt, o, m_new, l), None
+
+        (_, _, o, m, l), _ = lax.scan(step, (k0, v0, o0, m0, l0), jnp.arange(p))
+        # normalize; zero q pad rows explicitly (they attend to valid keys
+        # and would otherwise carry garbage into the pad region)
+        keep = (q_pos < n_q) & (l > 0)  # (..., bq, 1): broadcasts over D
+        o = jnp.where(keep, o / jnp.where(l > 0, l, 1.0), 0.0)
+        return o
+
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return jax.jit(fn)
+
+
+def ring_attention(
+    q: DNDarray,
+    k: DNDarray,
+    v: DNDarray,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> DNDarray:
+    """Exact scaled-dot-product attention with the sequence axis sharded
+    over the mesh (sequence parallelism for long contexts).
+
+    ``q``/``k``/``v``: (..., S, D) DNDarrays split along the S axis
+    (axis -2). Output matches q's shape and sharding. Unsplit inputs run
+    the same program on a size-1 ring (plain flash-style attention).
+    """
+    for name, t in (("q", q), ("k", k), ("v", v)):
+        if not isinstance(t, DNDarray):
+            raise TypeError(f"{name} must be a DNDarray, got {type(t)}")
+        if t.ndim < 2:
+            raise ValueError(f"{name} needs at least (S, D) dims, got {t.ndim}")
+    seq_axis = q.ndim - 2
+    if q.split not in (None, seq_axis) or k.split not in (None, seq_axis) or v.split not in (None, seq_axis):
+        raise ValueError(
+            f"ring_attention shards the sequence axis ({seq_axis}); got splits "
+            f"{q.split}/{k.split}/{v.split} — resplit the operands first"
+        )
+    if k.shape != v.shape:
+        raise ValueError(f"k and v must agree, got {k.shape} vs {v.shape}")
+    dtype = q.dtype if types.heat_type_is_inexact(q.dtype) else types.float32
+    jt = dtype.jax_type()
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+
+    comm = q.comm
+    if comm.size == 1 or q.split is None:
+        # single device / replicated q: dense softmax attention on the
+        # logical arrays (no ring needed; no pad in play)
+        qa, ka, va = (t.larray.astype(jt) for t in (q, k, v))
+        att = jnp.einsum("...qd,...kd->...qk", qa, ka) * jnp.asarray(scale, qa.dtype)
+        if causal:
+            qi = jax.lax.broadcasted_iota(jnp.int32, att.shape[-2:], 0)
+            ki = jax.lax.broadcasted_iota(jnp.int32, att.shape[-2:], 1)
+            att = jnp.where(ki <= qi, att, jnp.finfo(att.dtype).min)
+        out = jnp.einsum("...qk,...kd->...qd", jax.nn.softmax(att, axis=-1), va)
+        return DNDarray(
+            comm.shard(out, q.split), q.gshape, dtype, q.split, q.device, comm
+        )
+
+    qp = q._phys.astype(jt) if q.split == seq_axis else comm.shard(q.larray.astype(jt), seq_axis)
+    kp = k._phys.astype(jt) if k.split == seq_axis else comm.shard(k.larray.astype(jt), seq_axis)
+    vp = v._phys.astype(jt) if v.split == seq_axis else comm.shard(v.larray.astype(jt), seq_axis)
+    prog = _ring_attention_program(
+        comm.mesh, comm.axis_name, q.ndim, seq_axis,
+        q.shape[seq_axis], k.shape[seq_axis], bool(causal), float(scale),
+        np.dtype(jt).name,
+    )
+    out_phys = prog(qp, kp, vp)
+    return DNDarray(out_phys, q.gshape, dtype, seq_axis, q.device, comm)
+
+
+def ring_self_attention(x: DNDarray, causal: bool = False, scale: Optional[float] = None) -> DNDarray:
+    """Self-attention convenience: q = k = v = x."""
+    return ring_attention(x, x, x, causal=causal, scale=scale)
+
+
+# programs bake the mesh: clear on init_distributed world rebuilds
+register_mesh_cache(_ring_attention_program)

@@ -271,3 +271,15 @@ class TestSetitemReviewRegressions(TestCase):
     def test_checkpoint_reserved_keys_raise(self, tmp_path=None):
         with pytest.raises(ValueError):
             ht.utils.save_checkpoint("/tmp/reserved-ck", {"__tuple__": [1]})
+
+    def test_below_range_negative_step_is_noop(self):
+        a = ht.arange(13, split=0, dtype=ht.float32)
+        a[-20::-1] = 99.0
+        np.testing.assert_allclose(a.numpy(), np.arange(13))
+        a[5:2] = 42.0
+        np.testing.assert_allclose(a.numpy(), np.arange(13))
+
+    def test_too_many_indices_message(self):
+        a = ht.arange(5, split=0)
+        with pytest.raises(IndexError, match="too many"):
+            a[1, 2] = 0.0
