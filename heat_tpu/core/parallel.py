@@ -58,7 +58,7 @@ def _topk_program(mesh: Mesh, axis_name: str, ndim: int, split: int, k: int, lar
         kk = min(k, B)
         work = moved if largest else -moved
         lv, li = lax.top_k(work, kk)
-        gi = li.astype(idt) + (r * B).astype(idt)
+        gi = li.astype(idt) + r.astype(idt) * jnp.asarray(B, idt)
         # candidate sets are tiny: gather them everywhere
         cv = lax.all_gather(lv, axis_name, axis=0)   # (p, ..., kk)
         ci = lax.all_gather(gi, axis_name, axis=0)
@@ -302,7 +302,7 @@ def _oddeven_sort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx
         r = lax.axis_index(axis_name)
         B = v.shape[split]
         # global position of every local row along the split axis
-        i = lax.broadcasted_iota(idt, v.shape, split) + (r * B).astype(idt)
+        i = lax.broadcasted_iota(idt, v.shape, split) + r.astype(idt) * jnp.asarray(B, idt)
         v, i = lax.sort((v, i), dimension=split, num_keys=2)
         for t in range(p):
             start = t % 2

@@ -129,8 +129,17 @@ def ring_attention(
             f"ring_attention shards the sequence axis ({seq_axis}); got splits "
             f"{q.split}/{k.split}/{v.split} — resplit the operands first"
         )
-    if k.shape != v.shape:
-        raise ValueError(f"k and v must agree, got {k.shape} vs {v.shape}")
+    if k.shape[:-1] != v.shape[:-1]:
+        raise ValueError(
+            f"k and v must agree on batch/sequence dims, got {k.shape} vs {v.shape}"
+        )
+    if q.shape[-1] != k.shape[-1]:
+        raise ValueError(f"q and k head dims must agree, got {q.shape[-1]} vs {k.shape[-1]}")
+    if q.gshape[:-2] != k.gshape[:-2]:
+        raise ValueError(
+            f"q and k batch dims must agree, got {q.gshape[:-2]} vs {k.gshape[:-2]}"
+        )
+    out_gshape = q.gshape[:-1] + (v.gshape[-1],)
     dtype = q.dtype if types.heat_type_is_inexact(q.dtype) else types.float32
     jt = dtype.jax_type()
     if scale is None:
@@ -148,7 +157,7 @@ def ring_attention(
             att = jnp.where(ki <= qi, att, jnp.finfo(att.dtype).min)
         out = jnp.einsum("...qk,...kd->...qd", jax.nn.softmax(att, axis=-1), va)
         return DNDarray(
-            comm.shard(out, q.split), q.gshape, dtype, q.split, q.device, comm
+            comm.shard(out, q.split), out_gshape, dtype, q.split, q.device, comm
         )
 
     qp = q._phys.astype(jt) if q.split == seq_axis else comm.shard(q.larray.astype(jt), seq_axis)
@@ -160,7 +169,7 @@ def ring_attention(
         np.dtype(jt).name,
     )
     out_phys = prog(qp, kp, vp)
-    return DNDarray(out_phys, q.gshape, dtype, seq_axis, q.device, comm)
+    return DNDarray(out_phys, out_gshape, dtype, seq_axis, q.device, comm)
 
 
 def ring_self_attention(x: DNDarray, causal: bool = False, scale: Optional[float] = None) -> DNDarray:

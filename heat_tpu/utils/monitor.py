@@ -1,0 +1,106 @@
+"""Tracing / profiling instrumentation.
+
+The reference instruments its continuous benchmarks with the external
+``perun`` energy/runtime monitor (``@monitor()`` decorators,
+reference benchmarks/cb/linalg.py:4-23); the library itself ships no
+profiler. The TPU-native equivalents here:
+
+- ``@monitor()`` — the same decorator shape: wall-time (and, on TPU,
+  device-synchronized time) per call, accumulated in a module-level
+  registry; ``report()`` renders/returns it. Drop-in for porting the
+  reference's ``benchmarks/cb`` scripts.
+- ``trace(path)`` — context manager around ``jax.profiler`` emitting a
+  Perfetto/XPlane trace of everything inside (compile, HBM transfers,
+  collectives on ICI) for offline analysis in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import time
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+__all__ = ["monitor", "report", "reset", "trace"]
+
+_REGISTRY: Dict[str, Dict[str, float]] = {}
+
+
+def _blockable(out):
+    """Unwrap DNDarray leaves to their jax arrays: jax.block_until_ready
+    treats a DNDarray as an opaque pytree leaf and returns immediately,
+    which would make device work look free."""
+    from ..core.dndarray import DNDarray
+
+    if isinstance(out, DNDarray):
+        return out._phys
+    if isinstance(out, dict):
+        return {k: _blockable(v) for k, v in out.items()}
+    if isinstance(out, (list, tuple)):
+        return [_blockable(v) for v in out]
+    return out
+
+
+def monitor(name: Optional[str] = None, sync: bool = True):
+    """Decorator recording per-call wall time under ``name`` (defaults to
+    the function name) — the shape of perun's ``@monitor()`` used by the
+    reference's continuous benchmarks.
+
+    ``sync=True`` blocks on jax array outputs before stopping the clock,
+    so asynchronous dispatch doesn't make device work look free.
+    """
+
+    def deco(fn: Callable) -> Callable:
+        key = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            if sync:
+                try:
+                    jax.block_until_ready(_blockable(out))
+                except Exception:
+                    pass
+            dt = time.perf_counter() - t0
+            ent = _REGISTRY.setdefault(key, {"calls": 0, "total_s": 0.0, "best_s": float("inf")})
+            ent["calls"] += 1
+            ent["total_s"] += dt
+            ent["best_s"] = min(ent["best_s"], dt)
+            return out
+
+        return wrapper
+
+    return deco
+
+
+def report(as_json: bool = False) -> Any:
+    """Accumulated monitor table: {name: {calls, total_s, best_s, mean_s}}."""
+    table = {
+        k: {**v, "mean_s": v["total_s"] / v["calls"] if v["calls"] else 0.0}
+        for k, v in _REGISTRY.items()
+    }
+    if as_json:
+        return json.dumps(table)
+    return table
+
+
+def reset() -> None:
+    """Clear the monitor registry."""
+    _REGISTRY.clear()
+
+
+@contextlib.contextmanager
+def trace(path: str):
+    """Capture a jax.profiler trace (Perfetto/XPlane) of the enclosed
+    block to ``path`` — view in TensorBoard or ui.perfetto.dev. The
+    TPU-side story the reference delegates to perun's energy counters."""
+    jax.profiler.start_trace(path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

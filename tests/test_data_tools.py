@@ -320,3 +320,29 @@ class TestCheckpoint:
         assert isinstance(back["t"], tuple)
         np.testing.assert_allclose(back["t"][0].numpy(), np.arange(11, dtype=np.float32))
         assert back["t"][1] == 2
+
+
+class TestMonitor:
+    """@monitor decorator + registry (reference: perun @monitor in
+    benchmarks/cb/linalg.py:4-23; here a built-in equivalent)."""
+
+    def test_monitor_records_and_reports(self):
+        import heat_tpu as ht
+        from heat_tpu.utils import monitor as mon
+
+        mon.reset()
+
+        @mon.monitor()
+        def workload():
+            return ht.sum(ht.arange(100, split=0))
+
+        for _ in range(3):
+            workload()
+        table = mon.report()
+        assert table["workload"]["calls"] == 3
+        assert table["workload"]["total_s"] > 0
+        assert table["workload"]["best_s"] <= table["workload"]["mean_s"] * 1.0001
+        import json
+        assert json.loads(mon.report(as_json=True))["workload"]["calls"] == 3
+        mon.reset()
+        assert mon.report() == {}
