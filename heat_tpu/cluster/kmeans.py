@@ -17,6 +17,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from typing import Optional, Union
 
@@ -80,6 +81,16 @@ def _lloyd_step(k: int, shape, jdtype: str, use_pallas: Optional[bool] = None):
     return step
 
 
+@functools.lru_cache(maxsize=64)
+def _lloyd_loop(k: int, shape, jdtype: str, tol: float, max_iter: int):
+    """The ENTIRE Lloyd fit as one jitted program (centers, n_iter,
+    inertia) — see ``_kcluster.make_fit_loop``."""
+    from ._kcluster import make_fit_loop
+
+    step = _lloyd_step(k, shape, jdtype, use_pallas=False)
+    return make_fit_loop(step, jdtype, tol, max_iter, returns_inertia=True)
+
+
 class KMeans(_KCluster):
     """K-Means with Lloyd's algorithm (reference: kmeans.py:17).
 
@@ -139,15 +150,16 @@ class KMeans(_KCluster):
         if types.heat_type_is_exact(x.dtype):
             arr = arr.astype(jnp.float32)
         centers = self._cluster_centers.larray.astype(arr.dtype)
-        step = _lloyd_step(self.n_clusters, tuple(arr.shape), np.dtype(arr.dtype).name)
-
-        n_iter = 0
-        for n_iter in range(1, self.max_iter + 1):
-            centers, shift, inertia = step(arr, centers)
-            if float(shift) <= self.tol:
-                break
-        self._n_iter = n_iter
-        self._inertia = float(inertia)
+        # the whole fit is ONE on-device while_loop (no per-iteration host
+        # sync); n_iter/inertia come back in a single transfer
+        loop = _lloyd_loop(
+            self.n_clusters, tuple(arr.shape), np.dtype(arr.dtype).name,
+            float(self.tol), int(self.max_iter),
+        )
+        centers, n_iter_dev, inertia_dev = loop(arr, centers)
+        # keep as device scalars; n_iter_/inertia_ read them on access
+        self._n_iter = n_iter_dev
+        self._inertia = inertia_dev
         self._cluster_centers = DNDarray(
             jax.device_put(centers, x.comm.sharding(2, None)),
             (self.n_clusters, x.shape[1]),

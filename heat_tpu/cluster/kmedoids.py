@@ -15,6 +15,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from typing import Optional, Union
 
@@ -52,6 +53,15 @@ def _medoid_step(k: int, shape, jdtype: str):
     return step
 
 
+@functools.lru_cache(maxsize=64)
+def _fit_loop(k: int, shape, jdtype: str, tol: float, max_iter: int):
+    """Whole fit as one jitted while_loop — see ``_kcluster.make_fit_loop``."""
+    from ._kcluster import make_fit_loop
+
+    step = _medoid_step(k, shape, jdtype)
+    return make_fit_loop(step, jdtype, tol, max_iter, returns_inertia=False)
+
+
 class KMedoids(_KCluster):
     """K-Medoids: centers are actual data points; Manhattan metric
     throughout (reference: kmedoids.py:48)."""
@@ -85,14 +95,12 @@ class KMedoids(_KCluster):
         if types.heat_type_is_exact(x.dtype):
             arr = arr.astype(jnp.float32)
         centers = self._cluster_centers.larray.astype(arr.dtype)
-        step = _medoid_step(self.n_clusters, tuple(arr.shape), np.dtype(arr.dtype).name)
-
-        n_iter = 0
-        for n_iter in range(1, self.max_iter + 1):
-            centers, shift = step(arr, centers)
-            if float(shift) == 0.0:
-                break
-        self._n_iter = n_iter
+        loop = _fit_loop(
+            self.n_clusters, tuple(arr.shape), np.dtype(arr.dtype).name,
+            0.0, int(self.max_iter),
+        )
+        centers, n_iter_dev = loop(arr, centers)
+        self._n_iter = n_iter_dev  # lazy device scalar; n_iter_ reads it
         self._cluster_centers = DNDarray(
             jax.device_put(centers, x.comm.sharding(2, None)),
             (self.n_clusters, x.shape[1]),

@@ -64,11 +64,13 @@ def _ring_attention_program(
         q_pos = (r * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)).astype(jnp.int32)
 
         # constant-initialized carry entries must be marked device-varying:
-        # they mix with the rotating (varying) K/V blocks inside the scan
-        o0 = jnp.zeros_like(q)  # inherits q's device-varying vma
+        # they mix with the rotating (varying) K/V blocks inside the scan.
+        # o accumulates into V's head dim (which may differ from q's)
+        o0 = jnp.zeros(q.shape[:-1] + (v.shape[-1],), dtype=q.dtype)
         m0 = jnp.full(q.shape[:-1] + (1,), neg, dtype=q.dtype)
         l0 = jnp.zeros(q.shape[:-1] + (1,), dtype=q.dtype)
         if p > 1:
+            o0 = lax.pcast(o0, axis_name, to="varying")
             m0 = lax.pcast(m0, axis_name, to="varying")
             l0 = lax.pcast(l0, axis_name, to="varying")
         k0, v0 = k, v

@@ -64,7 +64,9 @@ def monitor(name: Optional[str] = None, sync: bool = True):
             if sync:
                 try:
                     jax.block_until_ready(_blockable(out))
-                except Exception:
+                except (TypeError, ValueError):
+                    # non-blockable output structure; device-execution
+                    # errors must propagate, not be recorded as timings
                     pass
             dt = time.perf_counter() - t0
             ent = _REGISTRY.setdefault(key, {"calls": 0, "total_s": 0.0, "best_s": float("inf")})

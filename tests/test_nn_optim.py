@@ -290,3 +290,15 @@ class TestRingAttention:
         x = ht.array(np.zeros((4, 8), dtype=np.float32), split=1)
         with pytest.raises(ValueError):
             ht.nn.ring_attention(x, x, x)
+
+    def test_value_head_dim_differs(self):
+        # Dv != Dq is legal attention; must work on the DISTRIBUTED ring
+        rng = np.random.default_rng(3)
+        S = 33
+        qn = rng.standard_normal((S, 4)).astype(np.float32)
+        kn = rng.standard_normal((S, 4)).astype(np.float32)
+        vn = rng.standard_normal((S, 6)).astype(np.float32)
+        out = ht.nn.ring_attention(*(ht.array(x, split=0) for x in (qn, kn, vn)))
+        assert out.shape == (S, 6)
+        ref = self._dense(qn, kn, vn, False, 1 / np.sqrt(4))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-5)
