@@ -137,18 +137,22 @@ class _KCluster(BaseEstimator, ClusteringMixin):
     @property
     def inertia_(self) -> float:
         """Sum of squared distances of samples to their closest center.
-        Stored as a lazy device scalar by fit; the host read happens here,
-        on access (a blocking read costs ~90 ms over the remote tunnel)."""
+        Stored as a lazy device scalar by fit; the first access pays the
+        host read (~90 ms over the remote tunnel) and caches the float."""
         if self._inertia is None:
             return None
-        return float(self._inertia)
+        if not isinstance(self._inertia, float):
+            self._inertia = float(self._inertia)
+        return self._inertia
 
     @property
     def n_iter_(self) -> int:
         """Number of iterations run (lazy device scalar; see inertia_)."""
         if self._n_iter is None:
             return None
-        return int(self._n_iter)
+        if not isinstance(self._n_iter, int):
+            self._n_iter = int(self._n_iter)
+        return self._n_iter
 
     # ------------------------------------------------------------------ #
     # initialization (reference: _kcluster.py:87-187)                    #

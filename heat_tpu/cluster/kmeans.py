@@ -17,7 +17,6 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from typing import Optional, Union
 

@@ -109,6 +109,13 @@ class TestDataParallel:
 
 
 class TestDASO:
+    @pytest.fixture(autouse=True)
+    def _needs_even_mesh(self):
+        # DASO's two-level ("node", "local") mesh factorization requires
+        # divisibility — same constraint as the reference's node groups
+        if ht.get_comm().size % 2 != 0:
+            pytest.skip("DASO n_nodes=2 needs an even mesh")
+
     def test_daso_converges_and_syncs(self):
         x_np, y_np = _toy_problem(n=512, seed=7)
         x = ht.array(x_np, split=0)

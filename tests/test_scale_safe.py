@@ -44,14 +44,16 @@ class TestShardedRandom:
         np.testing.assert_array_equal(x.numpy(), np.asarray(ref))
 
     def test_each_device_holds_only_its_shard(self):
-        x = ht.random.randn(800, 4, split=0)
+        p = ht.get_comm().size
+        x = ht.random.randn(100 * p, 4, split=0)
         shard_shapes = {tuple(s.data.shape) for s in x._phys.addressable_shards}
         assert shard_shapes == {(100, 4)}
 
     def test_pad_region_zero(self):
-        x = ht.random.randn(13, 3, split=0)  # pads 13 -> 16 on 8 devices
+        p = ht.get_comm().size
+        x = ht.random.randn(13, 3, split=0)  # pads 13 up to a mesh multiple
         phys = np.asarray(jax.device_get(x._phys))
-        assert phys.shape[0] == 16
+        assert phys.shape[0] == -(-13 // p) * p
         np.testing.assert_array_equal(phys[13:], 0.0)
         np.testing.assert_array_equal(x.numpy(), phys[:13])
 
@@ -121,10 +123,14 @@ class TestHDF5Hyperslab:
             f.create_dataset("d", data=data)
         x = ht.load(path, "d", split=0)
         np.testing.assert_array_equal(x.numpy(), data)
-        # every device holds exactly its 4-row slab
+        # every device holds exactly its block-row slab (zero-padded tail)
+        block = -(-32 // ht.get_comm().size)
         for s in x._phys.addressable_shards:
-            r0 = s.index[0].start
-            np.testing.assert_array_equal(np.asarray(s.data), data[r0 : r0 + 4])
+            r0 = s.index[0].start or 0
+            expect = np.zeros((block, 5), np.float32)
+            valid = max(0, min(32 - r0, block))
+            expect[:valid] = data[r0 : r0 + valid]
+            np.testing.assert_array_equal(np.asarray(s.data), expect)
 
     def test_save_writes_per_shard_slabs(self, tmp_path):
         """The file contents must equal the logical array even though no

@@ -11,7 +11,9 @@ from test_suites.basic_test import TestCase
 
 class TestSmoke(TestCase):
     def test_mesh(self):
-        self.assertEqual(self.comm.size, 8)
+        import jax
+
+        self.assertEqual(self.comm.size, len(jax.devices()))
 
     def test_arange_sum(self):
         # reference smoke test: scripts/heat_test.py
@@ -68,13 +70,17 @@ class TestSmoke(TestCase):
         self.assertEqual((x * 2.0).dtype, ht.float32)
 
     def test_reductions(self):
+        # seeded: an unseeded draw occasionally sums to ~0, where a pure
+        # relative tolerance on the f32 global sum flakes on accumulation
+        # order
+        np.random.seed(5)
         data = np.random.randn(6, 8, 4).astype(np.float32)
         for split in (None, 0, 1, 2):
             x = ht.array(data, split=split)
             self.assert_array_equal(x.sum(axis=0), data.sum(axis=0))
             self.assert_array_equal(x.sum(axis=1), data.sum(axis=1))
             self.assert_array_equal(x.sum(axis=(0, 2)), data.sum(axis=(0, 2)))
-            np.testing.assert_allclose(float(x.sum()), data.sum(), rtol=1e-4)
+            np.testing.assert_allclose(float(x.sum()), data.sum(), rtol=1e-4, atol=1e-4)
             self.assert_array_equal(
                 x.sum(axis=1, keepdims=True), data.sum(axis=1, keepdims=True)
             )
@@ -93,12 +99,13 @@ class TestSmoke(TestCase):
         self.assert_array_equal(x, data)
 
     def test_lshape_map(self):
+        p = self.comm.size
         x = ht.zeros((10, 4), split=0)
         lmap = x.lshape_map
-        self.assertEqual(lmap.shape, (8, 2))
+        self.assertEqual(lmap.shape, (p, 2))
         self.assertEqual(lmap[:, 0].sum(), 10)
-        # ceil-division convention: first shards have 2 rows
-        self.assertEqual(lmap[0, 0], 2)
+        # ceil-division convention: first shard holds the full block
+        self.assertEqual(lmap[0, 0], min(10, -(-10 // p)))
 
     def test_getitem_setitem(self):
         data = np.arange(48, dtype=np.float32).reshape(8, 6)
