@@ -34,6 +34,8 @@ KM_N, KM_D, KM_K = 1_048_576, 64, 8        # KMeans iter/s at scale
 RESHAPE_SHAPE = (1000, 250_000)            # cb uses 1000x10M..40M on a cluster
 CONCAT_SIZES = (10_000, 20_000, 40_000)    # benchmarks/cb/manipulations.py:20
 SUM_N = 100_000_000
+SORT_N = 16_777_216                        # distributed sort (values+indices)
+RA_B, RA_H, RA_S, RA_D = 4, 8, 4096, 64    # ring attention workload
 
 
 def _best_of(fn, reps: int = 3) -> float:
@@ -205,6 +207,20 @@ def measure_heat_tpu() -> dict:
     out["sum"] = amortized(lambda: ht.sum(s_in), inner=32)
     del s_in
 
+    # public ht.sort: values AND argsort indices (the reference returns
+    # both); the values-only half-traffic path is what percentile uses
+    srt = ht.random.randn(SORT_N, split=0)
+    out["sort"] = amortized(lambda: ht.sort(srt)[0], reps=2, inner=4)
+    del srt
+
+    # ring attention: sequence-parallel exact attention (single chip = dense
+    # flash-style path); B=4, H=8, S=4096, D=64 causal
+    qkv = [ht.random.randn(RA_B, RA_H, RA_S, RA_D, split=2) for _ in range(3)]
+    out["ring_attention"] = amortized(
+        lambda: ht.nn.ring_attention(*qkv, causal=True), reps=2, inner=4
+    )
+    del qkv
+
     # op-dispatch overhead: a chained elementwise expression through the
     # ht.* wrappers vs ONE hand-jitted jnp program on the same physical
     # array. Odd length exercises the pad-inside-jit path. The ht chain is
@@ -264,6 +280,12 @@ def main() -> None:
             ours["op_chain"] / ours["op_chain_fused_jnp"], 3
         )
     detail["kmeans_iter"]["iter_per_s"] = round(1.0 / ours["kmeans_iter"], 2)
+    if ours.get("sort"):
+        detail["sort"]["melem_per_s"] = round(SORT_N / ours["sort"] / 1e6, 1)
+    if ours.get("ring_attention"):
+        # 2 matmuls of (S,D)x(D,S) and (S,S)x(S,D) per head, causal ~ half
+        flops = RA_B * RA_H * 2 * 2 * RA_S * RA_S * RA_D * 0.5
+        detail["ring_attention"]["tflops"] = round(flops / ours["ring_attention"] / 1e12, 2)
     detail["sum"]["gbps"] = round(SUM_N * 4 / ours["sum"] / 1e9, 2)
     detail["hsvd"]["gbps"] = round(hsvd_gbps, 2)
 

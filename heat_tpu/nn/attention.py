@@ -38,6 +38,21 @@ from ..core import types
 __all__ = ["ring_attention", "ring_self_attention"]
 
 
+def _online_softmax_update(q, k_c, v_c, o, m, l, valid, scale, neg):
+    """One flash-attention accumulation step, shared by the ring program
+    (distributed) and the blocked program (single device) so the two paths
+    cannot numerically diverge: masked scores → running-max rescale →
+    (o, m, l) update."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k_c) * jnp.asarray(scale, q.dtype)
+    s = jnp.where(valid, s, neg)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    pexp = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + jnp.sum(pexp, axis=-1, keepdims=True)
+    o = o * corr + jnp.einsum("...qk,...kd->...qd", pexp, v_c)
+    return o, m_new, l
+
+
 @functools.lru_cache(maxsize=64)
 def _ring_attention_program(
     mesh: Mesh,
@@ -78,22 +93,15 @@ def _ring_attention_program(
         def step(carry, t):
             k_cur, v_cur, o, m, l = carry
             src = (r + t) % p
-            s = jnp.einsum("...qd,...kd->...qk", q, k_cur) * jnp.asarray(scale, q.dtype)
             k_pos = (src * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)).astype(jnp.int32)
             valid = k_pos < n_kv  # mask K/V pad rows
             if causal:
                 valid = valid & (k_pos <= q_pos)
-            s = jnp.where(valid, s, neg)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            pexp = jnp.exp(s - m_new)
-            pexp = jnp.where(valid, pexp, 0.0)
-            corr = jnp.exp(m - m_new)
-            l = l * corr + jnp.sum(pexp, axis=-1, keepdims=True)
-            o = o * corr + jnp.einsum("...qk,...kd->...qd", pexp, v_cur)
+            o, m, l = _online_softmax_update(q, k_cur, v_cur, o, m, l, valid, scale, neg)
             perm = [((i + 1) % p, i) for i in range(p)]
             k_nxt = lax.ppermute(k_cur, axis_name, perm) if p > 1 else k_cur
             v_nxt = lax.ppermute(v_cur, axis_name, perm) if p > 1 else v_cur
-            return (k_nxt, v_nxt, o, m_new, l), None
+            return (k_nxt, v_nxt, o, m, l), None
 
         (_, _, o, m, l), _ = lax.scan(step, (k0, v0, o0, m0, l0), jnp.arange(p))
         # normalize; zero q pad rows explicitly (they attend to valid keys
@@ -104,6 +112,54 @@ def _ring_attention_program(
 
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _blocked_attention_program(
+    q_shape, k_shape, v_shape, causal: bool, scale: float, jdtype: str
+):
+    """Single-device flash-style attention: ``lax.scan`` over K/V chunks
+    with the same online-softmax accumulation the ring uses — one
+    (S, chunk) tile live at a time instead of the full (S, S) scores."""
+    S_kv = k_shape[-2]
+    chunk = min(1024, S_kv)
+    n_chunks = -(-S_kv // chunk)
+    pad = n_chunks * chunk - S_kv
+    neg = jnp.finfo(jnp.dtype(jdtype)).min
+
+    def run(q, k, v):
+        if pad:
+            widths_k = [(0, 0)] * (k.ndim - 2) + [(0, pad), (0, 0)]
+            k = jnp.pad(k, widths_k)
+            v = jnp.pad(v, widths_k)
+        S_q = q.shape[-2]
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (S_q, 1), 0)
+        # (chunks, ..., chunk, d) leading scan axis
+        ks = jnp.moveaxis(
+            k.reshape(k.shape[:-2] + (n_chunks, chunk, k.shape[-1])), -3, 0
+        )
+        vs = jnp.moveaxis(
+            v.reshape(v.shape[:-2] + (n_chunks, chunk, v.shape[-1])), -3, 0
+        )
+
+        o0 = jnp.zeros(q.shape[:-1] + (v.shape[-1],), dtype=q.dtype)
+        m0 = jnp.full(q.shape[:-1] + (1,), neg, dtype=q.dtype)
+        l0 = jnp.zeros(q.shape[:-1] + (1,), dtype=q.dtype)
+
+        def step(carry, blk):
+            o, m, l, idx = carry
+            k_c, v_c = blk
+            k_pos = idx * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+            valid = k_pos < S_kv
+            if causal:
+                valid = valid & (k_pos <= q_pos)
+            o, m, l = _online_softmax_update(q, k_c, v_c, o, m, l, valid, scale, neg)
+            return (o, m, l, idx + 1), None
+
+        (o, _, l, _), _ = lax.scan(step, (o0, m0, l0, jnp.int32(0)), (ks, vs))
+        return jnp.where(l > 0, o / jnp.where(l > 0, l, 1.0), 0.0)
+
+    return jax.jit(run)
 
 
 def ring_attention(
@@ -149,15 +205,15 @@ def ring_attention(
 
     comm = q.comm
     if comm.size == 1 or q.split is None:
-        # single device / replicated q: dense softmax attention on the
-        # logical arrays (no ring needed; no pad in play)
+        # single device / replicated q: blocked flash-style attention —
+        # the dense formulation would materialize the (B, H, S, S) score
+        # tensor (2 GB at S=4k), the blocked scan keeps it one tile
         qa, ka, va = (t.larray.astype(jt) for t in (q, k, v))
-        att = jnp.einsum("...qd,...kd->...qk", qa, ka) * jnp.asarray(scale, qa.dtype)
-        if causal:
-            qi = jax.lax.broadcasted_iota(jnp.int32, att.shape[-2:], 0)
-            ki = jax.lax.broadcasted_iota(jnp.int32, att.shape[-2:], 1)
-            att = jnp.where(ki <= qi, att, jnp.finfo(att.dtype).min)
-        out = jnp.einsum("...qk,...kd->...qd", jax.nn.softmax(att, axis=-1), va)
+        prog = _blocked_attention_program(
+            tuple(qa.shape), tuple(ka.shape), tuple(va.shape),
+            bool(causal), float(scale), np.dtype(jt).name,
+        )
+        out = prog(qa, ka, va)
         return DNDarray(
             comm.shard(out, q.split), out_gshape, dtype, q.split, q.device, comm
         )
