@@ -35,4 +35,5 @@ from . import regression
 from . import sparse
 from . import spatial
 from . import utils
+from . import datasets
 from .version import __version__

@@ -18,6 +18,7 @@ __all__ = [
     "sanitize_in",
     "sanitize_in_tensor",
     "sanitize_lshape",
+    "sanitize_infinity",
     "sanitize_out",
     "sanitize_sequence",
     "scalar_to_1d",
@@ -131,3 +132,16 @@ def scalar_to_1d(x):
         comm=x.comm,
         balanced=True,
     )
+
+
+def sanitize_infinity(x):
+    """Largest representable value for the input's dtype — float for
+    inexact dtypes, int for integer dtypes (reference: sanitation.py:176,
+    a +inf stand-in usable in integer comparisons)."""
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(x.dtype.jax_type()) if hasattr(x.dtype, "jax_type") else jnp.dtype(x.dtype)
+    try:
+        return float(jnp.finfo(dt).max)
+    except ValueError:
+        return int(jnp.iinfo(dt).max)

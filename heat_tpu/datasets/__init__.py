@@ -1,0 +1,28 @@
+"""Bundled example datasets (reference: /root/reference/heat/datasets —
+iris and diabetes shipped as HDF5/CSV for tests and examples). The files
+here are materialized from the public scikit-learn distributions of the
+same classic datasets (Fisher's iris, the sklearn diabetes study), not
+copied from the reference repository.
+
+Use with the io layer::
+
+    import heat_tpu as ht
+    from heat_tpu import datasets
+
+    x = ht.load_hdf5(datasets.path("iris.h5"), "data", split=0)
+"""
+
+import os
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+__all__ = ["path"]
+
+
+def path(name: str) -> str:
+    """Absolute path of a bundled dataset file (iris.h5, iris.csv,
+    iris_labels.csv, diabetes.h5)."""
+    p = os.path.join(_DIR, name)
+    if not os.path.exists(p):
+        raise FileNotFoundError(f"no bundled dataset {name!r} in {_DIR}")
+    return p

@@ -122,8 +122,8 @@ def _blocked_attention_program(
     with the same online-softmax accumulation the ring uses — one
     (S, chunk) tile live at a time instead of the full (S, S) scores."""
     S_kv = k_shape[-2]
-    chunk = min(1024, S_kv)
-    n_chunks = -(-S_kv // chunk)
+    chunk = max(1, min(1024, S_kv))
+    n_chunks = max(1, -(-S_kv // chunk))
     pad = n_chunks * chunk - S_kv
     neg = jnp.finfo(jnp.dtype(jdtype)).min
 

@@ -86,6 +86,50 @@ class TestIO(TestCase):
         self.assertFalse(ht.supports_netcdf())
 
 
+class TestBundledDatasets(TestCase):
+    """heat_tpu.datasets — the analog of the reference's bundled
+    heat/datasets files (iris/diabetes), materialized from the public
+    scikit-learn distributions."""
+
+    def test_iris_hdf5(self):
+        from heat_tpu import datasets
+
+        x = ht.load_hdf5(datasets.path("iris.h5"), "data", split=0)
+        assert x.shape == (150, 4)
+        # classic iris sanity: sepal lengths within [4.3, 7.9]
+        col0 = x.numpy()[:, 0]
+        assert col0.min() >= 4.2 and col0.max() <= 8.0
+
+    def test_iris_csv_and_labels(self):
+        from heat_tpu import datasets
+
+        x = ht.load_csv(datasets.path("iris.csv"), sep=";", split=0)
+        assert x.shape == (150, 4)
+        y = ht.load_csv(datasets.path("iris_labels.csv"), sep=";", split=0)
+        assert int(ht.max(y)) == 2
+
+    def test_diabetes_hdf5(self):
+        from heat_tpu import datasets
+
+        x = ht.load_hdf5(datasets.path("diabetes.h5"), "x", split=0)
+        assert x.shape == (442, 10)
+
+    def test_missing_raises(self):
+        from heat_tpu import datasets
+
+        with self.assertRaises(FileNotFoundError):
+            datasets.path("nope.h5")
+
+    def test_estimator_on_iris(self):
+        # the reference's test pattern: fit estimators on the bundled data
+        from heat_tpu import datasets
+
+        x = ht.load_hdf5(datasets.path("iris.h5"), "data", split=0)
+        km = ht.cluster.KMeans(n_clusters=3, init="kmeans++", random_state=0).fit(x)
+        assert km.cluster_centers_.shape == (3, 4)
+        assert km.labels_.shape == (150,)
+
+
 if __name__ == "__main__":
     import unittest
 

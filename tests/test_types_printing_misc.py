@@ -283,3 +283,13 @@ class TestSetitemReviewRegressions(TestCase):
         a = ht.arange(5, split=0)
         with pytest.raises(IndexError, match="too many"):
             a[1, 2] = 0.0
+
+
+class TestSanitizeInfinity(TestCase):
+    def test_float_and_int_branches(self):
+        from heat_tpu.core.sanitation import sanitize_infinity
+
+        assert sanitize_infinity(ht.arange(3, dtype=ht.int32)) == 2**31 - 1
+        assert sanitize_infinity(ht.arange(3, dtype=ht.int8)) == 127
+        assert sanitize_infinity(ht.arange(3.0)) > 1e38
+        assert sanitize_infinity(ht.arange(3.0, dtype=ht.float64)) > 1e300
