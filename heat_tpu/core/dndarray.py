@@ -48,6 +48,29 @@ class LocalIndex:
         self.obj = obj
 
 
+class _LocalAccessor:
+    """``DNDarray.lloc`` accessor (reference dndarray.py ``lloc``): index
+    the process-local data directly, bypassing global-index translation.
+    Single-controller: the local data is the LOGICAL global array — the
+    padded physical tail is an implementation detail (its zero invariant
+    must not be readable or writable through this accessor)."""
+
+    __slots__ = ("_dnd",)
+
+    def __init__(self, dnd: "DNDarray"):
+        self._dnd = dnd
+
+    def __getitem__(self, key):
+        return self._dnd.larray[key]
+
+    def __setitem__(self, key, value):
+        d = self._dnd
+        new = d.larray.at[key].set(
+            jnp.asarray(value, dtype=d.dtype.jax_type())
+        )
+        d._set_phys(d.comm.shard(new, d.split))
+
+
 class DNDarray:
     """Distributed n-dimensional array over a TPU/CPU device mesh.
 
@@ -181,6 +204,12 @@ class DNDarray:
         self.__halos = None
         self.__halo_prev = None
         self.__halo_next = None
+
+    @property
+    def lloc(self) -> "_LocalAccessor":
+        """Local-index accessor (reference dndarray.py lloc): read/write
+        the process-local (physical) data without global translation."""
+        return _LocalAccessor(self)
 
     @property
     def nbytes(self) -> int:

@@ -136,12 +136,15 @@ def scalar_to_1d(x):
 
 def sanitize_infinity(x):
     """Largest representable value for the input's dtype — float for
-    inexact dtypes, int for integer dtypes (reference: sanitation.py:176,
-    a +inf stand-in usable in integer comparisons)."""
-    import jax.numpy as jnp
+    inexact dtypes, int for integers, True for bool (reference:
+    sanitation.py:176, a +inf stand-in usable in integer comparisons).
+    Dispatches through ``types.finfo``/``types.iinfo`` (the canonical
+    dtype-extreme helpers)."""
+    from . import types
 
-    dt = jnp.dtype(x.dtype.jax_type()) if hasattr(x.dtype, "jax_type") else jnp.dtype(x.dtype)
-    try:
-        return float(jnp.finfo(dt).max)
-    except ValueError:
-        return int(jnp.iinfo(dt).max)
+    dtype = types.canonical_heat_type(x.dtype)
+    if dtype is types.bool:
+        return True
+    if types.heat_type_is_inexact(dtype):
+        return float(types.finfo(dtype).max)
+    return int(types.iinfo(dtype).max)

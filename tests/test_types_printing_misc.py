@@ -293,3 +293,20 @@ class TestSanitizeInfinity(TestCase):
         assert sanitize_infinity(ht.arange(3, dtype=ht.int8)) == 127
         assert sanitize_infinity(ht.arange(3.0)) > 1e38
         assert sanitize_infinity(ht.arange(3.0, dtype=ht.float64)) > 1e300
+
+
+class TestLloc(TestCase):
+    def test_local_accessor_read_write(self):
+        x = ht.arange(16, split=0, dtype=ht.float32)
+        assert float(np.asarray(jax.device_get(x.lloc[3]))) == 3.0
+        x.lloc[0] = 99.0
+        assert float(x.numpy()[0]) == 99.0
+
+    def test_lloc_logical_bounds_on_uneven_split(self):
+        # tail/negative indices are LOGICAL: the physical pad is invisible
+        x = ht.arange(10, split=0, dtype=ht.float32)
+        assert float(np.asarray(jax.device_get(x.lloc[-1]))) == 9.0
+        x.lloc[-1] = 5.0
+        assert float(x.numpy()[9]) == 5.0
+        phys = np.asarray(jax.device_get(x._phys))
+        assert np.all(phys[10:] == 0)
