@@ -50,10 +50,11 @@ class LocalIndex:
 
 class _LocalAccessor:
     """``DNDarray.lloc`` accessor (reference dndarray.py ``lloc``): index
-    the process-local data directly, bypassing global-index translation.
-    Single-controller: the local data is the LOGICAL global array — the
-    padded physical tail is an implementation detail (its zero invariant
-    must not be readable or writable through this accessor)."""
+    the process-local data directly. Single-controller: the local data IS
+    the logical global array, so this delegates to the DNDarray indexing
+    machinery — same bounds discipline (IndexError on out-of-range basic
+    keys, like the reference's torch-backed lloc), same DNDarray-value
+    unwrapping, same fused physical-scatter fast path for basic keys."""
 
     __slots__ = ("_dnd",)
 
@@ -61,14 +62,14 @@ class _LocalAccessor:
         self._dnd = dnd
 
     def __getitem__(self, key):
-        return self._dnd.larray[key]
+        d = self._dnd
+        basic = d._DNDarray__normalize_basic_key(key)
+        if basic is not None:
+            return d.larray[basic]
+        return d.larray[key]
 
     def __setitem__(self, key, value):
-        d = self._dnd
-        new = d.larray.at[key].set(
-            jnp.asarray(value, dtype=d.dtype.jax_type())
-        )
-        d._set_phys(d.comm.shard(new, d.split))
+        self._dnd[key] = value
 
 
 class DNDarray:

@@ -310,3 +310,12 @@ class TestLloc(TestCase):
         assert float(x.numpy()[9]) == 5.0
         phys = np.asarray(jax.device_get(x._phys))
         assert np.all(phys[10:] == 0)
+
+    def test_lloc_bounds_discipline(self):
+        x = ht.arange(10, split=0, dtype=ht.float32)
+        with pytest.raises(IndexError):
+            x.lloc[50]
+        with pytest.raises(IndexError):
+            x.lloc[50] = 7.0
+        x.lloc[0:2] = ht.array(np.array([7.0, 8.0], np.float32))
+        assert list(x.numpy()[:2]) == [7.0, 8.0]
