@@ -63,9 +63,14 @@ class _LocalAccessor:
 
     def __getitem__(self, key):
         d = self._dnd
-        basic = d._DNDarray__normalize_basic_key(key)
-        if basic is not None:
-            return d.larray[basic]
+        if not isinstance(key, (DNDarray, jax.Array, np.ndarray)):
+            basic = d._DNDarray__normalize_basic_key(key)
+            if basic is not None:
+                return d.larray[basic]
+        if isinstance(key, DNDarray):
+            key = key.larray
+        elif isinstance(key, tuple):
+            key = tuple(k.larray if isinstance(k, DNDarray) else k for k in key)
         return d.larray[key]
 
     def __setitem__(self, key, value):

@@ -330,10 +330,30 @@ def repeat(a, repeats, axis: Optional[int] = None) -> DNDarray:
     return _wrap(result, split, a, dtype=a.dtype)
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=1024)
+def _reshape_program(comm, in_gshape, in_split, out_shape, out_split):
+    """One compiled program for reshape-with-repartition: unpad slice →
+    reshape → output pad, with the output sharding pinned — XLA fuses the
+    copies and emits the all-to-all (the reference's Alltoallv,
+    manipulations.py:1994) in the same program. The eager formulation paid
+    separate unpad/reshape/pad/device_put passes."""
+    from . import _padding
+
+    def fn(phys):
+        logical = _padding.unpad(phys, in_gshape, in_split)
+        r = jnp.reshape(logical, out_shape)
+        return _padding.pad_logical(r, out_split, comm.size)
+
+    return jax.jit(fn, out_shardings=comm.sharding(len(out_shape), out_split))
+
+
 def reshape(a: DNDarray, *shape, **kwargs) -> DNDarray:
     """Reshape without changing data (reference: manipulations.py:1994 —
-    Alltoallv repartition with ``new_split`` kw; here a jnp.reshape plus one
-    resharding, the all-to-all emitted by XLA)."""
+    Alltoallv repartition with ``new_split`` kw; one jitted
+    reshape+repartition program, the all-to-all emitted by XLA)."""
     sanitize_in(a)
     if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
         shape = tuple(shape[0])
@@ -360,6 +380,10 @@ def reshape(a: DNDarray, *shape, **kwargs) -> DNDarray:
             # fewer output dims than the old split axis: clamp to the last
             new_split = len(shape) - 1
     new_split = sanitize_axis(shape, new_split)
+    if new_split is not None and len(shape) > 0 and a.ndim > 0:
+        prog = _reshape_program(a.comm, a.gshape, a.split, tuple(shape), new_split)
+        phys = prog(a._phys)
+        return DNDarray(phys, tuple(shape), a.dtype, new_split, a.device, a.comm)
     result = jnp.reshape(a.larray, shape)
     return _wrap(result, new_split, a, dtype=a.dtype)
 
@@ -680,3 +704,8 @@ DNDarray.concatenate = lambda self, others, axis=0: concatenate([self] + list(ot
 DNDarray.moveaxis = moveaxis
 DNDarray.swapaxes = swapaxes
 DNDarray.broadcast_to = broadcast_to
+
+from .communication import register_mesh_cache
+
+# entries bake mesh geometry: cleared when init_distributed rebuilds the world
+register_mesh_cache(_reshape_program)

@@ -319,3 +319,18 @@ class TestLloc(TestCase):
             x.lloc[50] = 7.0
         x.lloc[0:2] = ht.array(np.array([7.0, 8.0], np.float32))
         assert list(x.numpy()[:2]) == [7.0, 8.0]
+
+    def test_lloc_mask_get_set_symmetric(self):
+        y = ht.arange(10, split=0, dtype=ht.float32)
+        m = y > 5
+        got = np.asarray(jax.device_get(y.lloc[m]))
+        np.testing.assert_array_equal(got, np.arange(6, 10))
+        y.lloc[m] = 0.0
+        assert float(y.numpy().sum()) == sum(range(6))
+
+
+class TestScalarReshape(TestCase):
+    def test_reshape_to_scalar(self):
+        r = ht.array(np.array([5.0], np.float32), split=0).reshape(())
+        assert float(r.numpy()) == 5.0
+        assert r.split is None
