@@ -1,0 +1,178 @@
+"""Breadth sweep for statistics and manipulations: every op against its
+numpy/scipy oracle across splits and uneven extents (the reference's
+test_statistics.py / test_manipulations.py coverage shape)."""
+
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+import heat_tpu as ht
+
+_RNG = np.random.default_rng(7)
+_D = _RNG.standard_normal((13, 6)).astype(np.float32)  # uneven rows on 8 devs
+_V = _RNG.standard_normal(45).astype(np.float32)
+
+_SPLITS_2D = [None, 0, 1]
+
+
+class TestStatisticsSweep:
+    @pytest.mark.parametrize("split", _SPLITS_2D)
+    @pytest.mark.parametrize("axis", [None, 0, 1])
+    def test_var_std_ddof(self, split, axis):
+        x = ht.array(_D, split=split)
+        for ddof in (0, 1):
+            np.testing.assert_allclose(
+                np.asarray(ht.var(x, axis=axis, ddof=ddof).numpy()),
+                _D.var(axis=axis, ddof=ddof),
+                rtol=2e-4, atol=2e-5,
+            )
+            np.testing.assert_allclose(
+                np.asarray(ht.std(x, axis=axis, ddof=ddof).numpy()),
+                _D.std(axis=axis, ddof=ddof),
+                rtol=2e-4, atol=2e-5,
+            )
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_skew_kurtosis_vs_scipy(self, split):
+        v = ht.array(_V, split=split)
+        np.testing.assert_allclose(
+            float(ht.skew(v, unbiased=False)), sps.skew(_V, bias=True), rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            float(ht.kurtosis(v, unbiased=False)),
+            sps.kurtosis(_V, fisher=True, bias=True),
+            rtol=1e-3, atol=1e-3,
+        )
+
+    @pytest.mark.parametrize("split", _SPLITS_2D)
+    def test_cov(self, split):
+        x = ht.array(_D, split=split)
+        np.testing.assert_allclose(
+            ht.cov(x).numpy(), np.cov(_D), rtol=1e-4, atol=1e-5
+        )
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_histogram_bincount(self, split):
+        v = ht.array(_V, split=split)
+        hist, edges = ht.histogram(v, bins=7)
+        ref_h, ref_e = np.histogram(_V, bins=7)
+        np.testing.assert_array_equal(np.asarray(hist.numpy()), ref_h)
+        np.testing.assert_allclose(np.asarray(edges.numpy()), ref_e, rtol=1e-5)
+        iv = np.abs((_V * 3).astype(np.int32))
+        np.testing.assert_array_equal(
+            ht.bincount(ht.array(iv, split=split)).numpy(), np.bincount(iv)
+        )
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_digitize_bucketize(self, split):
+        v = ht.array(_V, split=split)
+        bins = np.array([-1.0, 0.0, 1.0], dtype=np.float32)
+        np.testing.assert_array_equal(
+            ht.digitize(v, bins).numpy(), np.digitize(_V, bins)
+        )
+
+    @pytest.mark.parametrize("split", _SPLITS_2D)
+    @pytest.mark.parametrize("axis", [None, 0, 1])
+    def test_argmax_argmin(self, split, axis):
+        x = ht.array(_D, split=split)
+        np.testing.assert_array_equal(
+            np.asarray(ht.argmax(x, axis=axis).numpy()), _D.argmax(axis=axis)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ht.argmin(x, axis=axis).numpy()), _D.argmin(axis=axis)
+        )
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_maximum_minimum_elementwise(self, split):
+        a = ht.array(_D, split=split)
+        b = ht.array(_D[::-1].copy(), split=split)
+        np.testing.assert_array_equal(
+            ht.maximum(a, b).numpy(), np.maximum(_D, _D[::-1])
+        )
+        np.testing.assert_array_equal(
+            ht.minimum(a, b).numpy(), np.minimum(_D, _D[::-1])
+        )
+
+
+class TestManipulationsSweep:
+    @pytest.mark.parametrize("split", _SPLITS_2D)
+    def test_roll(self, split):
+        x = ht.array(_D, split=split)
+        for shift, axis in ((3, 0), (-2, 1), (5, None)):
+            np.testing.assert_array_equal(
+                ht.roll(x, shift, axis=axis).numpy(), np.roll(_D, shift, axis=axis)
+            )
+
+    @pytest.mark.parametrize("split", _SPLITS_2D)
+    def test_pad(self, split):
+        x = ht.array(_D, split=split)
+        np.testing.assert_array_equal(
+            ht.pad(x, ((1, 2), (0, 3))).numpy(), np.pad(_D, ((1, 2), (0, 3)))
+        )
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_unique_sorted(self, split):
+        v = np.tile(np.arange(5, dtype=np.float32), 9)
+        x = ht.array(v, split=split)
+        got = ht.unique(x, sorted=True)
+        np.testing.assert_array_equal(np.sort(got.numpy()), np.unique(v))
+
+    @pytest.mark.parametrize("split", _SPLITS_2D)
+    def test_moveaxis_swapaxes_rot90(self, split):
+        x = ht.array(_D, split=split)
+        np.testing.assert_array_equal(
+            ht.moveaxis(x, 0, 1).numpy(), np.moveaxis(_D, 0, 1)
+        )
+        np.testing.assert_array_equal(
+            ht.swapaxes(x, 0, 1).numpy(), np.swapaxes(_D, 0, 1)
+        )
+        np.testing.assert_array_equal(ht.rot90(x).numpy(), np.rot90(_D))
+
+    @pytest.mark.parametrize("split", _SPLITS_2D)
+    def test_stack_family(self, split):
+        x = ht.array(_D, split=split)
+        np.testing.assert_array_equal(
+            ht.stack([x, x], axis=0).numpy(), np.stack([_D, _D], axis=0)
+        )
+        np.testing.assert_array_equal(ht.vstack([x, x]).numpy(), np.vstack([_D, _D]))
+        np.testing.assert_array_equal(ht.hstack([x, x]).numpy(), np.hstack([_D, _D]))
+        np.testing.assert_array_equal(
+            ht.column_stack([x, x]).numpy(), np.column_stack([_D, _D])
+        )
+
+    @pytest.mark.parametrize("split", _SPLITS_2D)
+    def test_tile_repeat(self, split):
+        x = ht.array(_D, split=split)
+        np.testing.assert_array_equal(ht.tile(x, (2, 2)).numpy(), np.tile(_D, (2, 2)))
+        np.testing.assert_array_equal(ht.repeat(x, 2).numpy(), np.repeat(_D, 2))
+
+    @pytest.mark.parametrize("split", _SPLITS_2D)
+    @pytest.mark.parametrize("new_split", [None, 0, 1])
+    def test_reshape_split_matrix(self, split, new_split):
+        x = ht.array(_D[:12], split=split)  # 12x6 → 8x9
+        got = ht.reshape(x, (8, 9), new_split=new_split)
+        np.testing.assert_array_equal(got.numpy(), _D[:12].reshape(8, 9))
+        if new_split is not None:
+            assert got.split == new_split
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_flatten_ravel(self, split):
+        x = ht.array(_D, split=split)
+        np.testing.assert_array_equal(ht.flatten(x).numpy(), _D.ravel())
+        np.testing.assert_array_equal(ht.ravel(x).numpy(), _D.ravel())
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_concatenate_mixed_splits(self, split):
+        x = ht.array(_D, split=split)
+        y = ht.array(_D, split=0)
+        np.testing.assert_array_equal(
+            ht.concatenate([x, y], axis=0).numpy(), np.concatenate([_D, _D], 0)
+        )
+
+
+class TestReshapeEdges:
+    def test_empty_array_reshape(self):
+        x = ht.array(np.empty((0, 4), np.float32), split=0)
+        r = x.reshape(0, 2, 2)
+        assert r.shape == (0, 2, 2)
+        assert r.numpy().shape == (0, 2, 2)
