@@ -1,0 +1,53 @@
+"""Hierarchical SVD of a distributed matrix — the north-star operation
+(reference blog: hSVD of a 200 GB dataset; BASELINE.json target).
+
+    python examples/hsvd.py [--rows 16384] [--cols 2048] [--rank 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# allow running straight from a checkout: examples/.. is the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# honor JAX_PLATFORMS even when a site PJRT plugin overrides it (see
+# tests/conftest.py: env alone is not reliably honored)
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import time
+
+import heat_tpu as ht
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=16384)
+    p.add_argument("--cols", type=int, default=2048)
+    p.add_argument("--rank", type=int, default=10)
+    args = p.parse_args()
+
+    ht.random.seed(0)
+    a = ht.random.randn(args.rows, args.cols, split=0)
+    ht.print0(f"A: {a.shape} split={a.split} over {a.comm.size} device(s)")
+
+    t0 = time.perf_counter()
+    u, sigma, v, err = ht.linalg.hsvd_rank(a, args.rank, compute_sv=True)
+    _ = u.numpy()  # materialize before stopping the clock
+    dt = time.perf_counter() - t0
+
+    gb = args.rows * args.cols * 4 / 1e9
+    ht.print0(
+        f"hsvd_rank(r={args.rank}): {dt*1000:.1f} ms  "
+        f"({gb/dt:.1f} GB/s/chip)  rel-err estimate {float(err):.3f}"
+    )
+    ht.print0(f"sigma: {sigma.numpy().round(2)}")
+
+
+if __name__ == "__main__":
+    main()

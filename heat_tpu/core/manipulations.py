@@ -380,14 +380,9 @@ def reshape(a: DNDarray, *shape, **kwargs) -> DNDarray:
             # fewer output dims than the old split axis: clamp to the last
             new_split = len(shape) - 1
     new_split = sanitize_axis(shape, new_split)
-    if (
-        new_split is not None
-        and len(shape) > 0
-        and a.ndim > 0
-        and shape[new_split] != 0
-    ):
-        # zero-extent split dims take the eager path: comm.shard stores
-        # them replicated, which a pinned out_sharding cannot express
+    if new_split is not None and len(shape) > 0 and a.ndim > 0 and a.size != 0:
+        # zero-SIZE arrays take the eager path: XLA stores them replicated,
+        # which a pinned out_sharding cannot express
         prog = _reshape_program(a.comm, a.gshape, a.split, tuple(shape), new_split)
         phys = prog(a._phys)
         return DNDarray(phys, tuple(shape), a.dtype, new_split, a.device, a.comm)

@@ -1,0 +1,124 @@
+"""Linear-algebra breadth sweep: dense ops, factorizations and solvers
+across splits, shapes (tall/wide/uneven) and dtypes — the shape-loop
+coverage of the reference's core/linalg/tests (test_qr.py loops
+split × tiles_per_proc; test_basics.py loops split pairs)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+_RNG = np.random.default_rng(11)
+
+
+class TestDenseOpsSweep:
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_norms(self, split):
+        d = _RNG.standard_normal((9, 5)).astype(np.float32)
+        x = ht.array(d, split=split)
+        np.testing.assert_allclose(float(ht.linalg.norm(x)), np.linalg.norm(d), rtol=1e-5)
+        v = _RNG.standard_normal(23).astype(np.float32)
+        y = ht.array(v, split=0 if split is not None else None)
+        np.testing.assert_allclose(float(ht.linalg.norm(y)), np.linalg.norm(v), rtol=1e-5)
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_inv_det_trace(self, split):
+        d = _RNG.standard_normal((6, 6)).astype(np.float64)
+        d = d @ d.T + 6 * np.eye(6)
+        x = ht.array(d, split=split)
+        np.testing.assert_allclose(ht.linalg.inv(x).numpy(), np.linalg.inv(d), rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(float(ht.linalg.det(x)), np.linalg.det(d), rtol=1e-6)
+        np.testing.assert_allclose(float(ht.trace(x)), np.trace(d), rtol=1e-8)
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_dot_vdot_outer(self, split):
+        a = _RNG.standard_normal(17).astype(np.float32)
+        b = _RNG.standard_normal(17).astype(np.float32)
+        x, y = ht.array(a, split=split), ht.array(b, split=split)
+        np.testing.assert_allclose(float(ht.dot(x, y)), a @ b, rtol=1e-4)
+        np.testing.assert_allclose(float(ht.vdot(x, y)), np.vdot(a, b), rtol=1e-4)
+        np.testing.assert_allclose(ht.outer(x, y).numpy(), np.outer(a, b), rtol=1e-5)
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_tril_triu_transpose(self, split):
+        d = _RNG.standard_normal((7, 10)).astype(np.float32)
+        x = ht.array(d, split=split)
+        np.testing.assert_array_equal(ht.tril(x).numpy(), np.tril(d))
+        np.testing.assert_array_equal(ht.triu(x, 1).numpy(), np.triu(d, 1))
+        np.testing.assert_array_equal(ht.transpose(x).numpy(), d.T)
+
+    @pytest.mark.parametrize("sa", [None, 0, 1])
+    @pytest.mark.parametrize("sb", [None, 0, 1])
+    def test_matmul_split_matrix_uneven(self, sa, sb):
+        a = _RNG.standard_normal((11, 7)).astype(np.float32)
+        b = _RNG.standard_normal((7, 13)).astype(np.float32)
+        x, y = ht.array(a, split=sa), ht.array(b, split=sb)
+        np.testing.assert_allclose(ht.matmul(x, y).numpy(), a @ b, rtol=2e-4, atol=2e-4)
+
+
+class TestFactorizationsSweep:
+    @pytest.mark.parametrize("m,n", [(40, 7), (23, 5), (8, 8), (5, 9)])
+    @pytest.mark.parametrize("split", [0, None])
+    def test_qr_shapes(self, m, n, split):
+        d = _RNG.standard_normal((m, n)).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(d, split=split))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), d, rtol=2e-3, atol=2e-3)
+        k = min(m, n)
+        np.testing.assert_allclose(
+            q.numpy().T @ q.numpy(), np.eye(q.shape[1]), atol=2e-3
+        )
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_full_svd(self, split):
+        d = _RNG.standard_normal((24, 9)).astype(np.float32)
+        u, s, vt = ht.linalg.svd(ht.array(d, split=split))
+        rec = u.numpy() @ np.diag(s.numpy()) @ vt.numpy()
+        np.testing.assert_allclose(rec, d, rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(
+            np.sort(s.numpy())[::-1], np.linalg.svd(d, compute_uv=False), rtol=2e-3
+        )
+
+    @pytest.mark.parametrize("split", [0, 1])
+    def test_hsvd_rtol_bound_holds(self, split):
+        d = _RNG.standard_normal((40, 24)).astype(np.float32)
+        x = ht.array(d, split=split)
+        u, s, v, err = ht.linalg.hsvd_rtol(x, 0.3, compute_sv=True)
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        rel = np.linalg.norm(d - rec) / np.linalg.norm(d)
+        assert rel <= 0.3 + 1e-2, (rel, float(err))
+
+    @pytest.mark.parametrize("split", [0, 1])
+    def test_hsvd_rank_known_rank(self, split):
+        from heat_tpu.utils.data.matrixgallery import random_known_rank
+
+        data, (u_t, s_t, v_t) = random_known_rank(36, 16, 3, split=split)
+        u, s, v, err = ht.linalg.hsvd_rank(data, 3, compute_sv=True)
+        np.testing.assert_allclose(
+            np.sort(s.numpy())[::-1], np.sort(s_t.numpy())[::-1], rtol=1e-2
+        )
+        assert float(err) < 1e-3
+
+
+class TestSolversSweep:
+    @pytest.mark.parametrize("split", [None, 0])
+    @pytest.mark.parametrize("n", [8, 19])
+    def test_cg_sizes(self, split, n):
+        a = _RNG.standard_normal((n, n)).astype(np.float32)
+        a = a @ a.T + n * np.eye(n, dtype=np.float32)
+        b = _RNG.standard_normal(n).astype(np.float32)
+        x = ht.linalg.cg(ht.array(a, split=split), ht.array(b), ht.zeros(n))
+        np.testing.assert_allclose(x.numpy(), np.linalg.solve(a, b), atol=5e-3)
+
+    @pytest.mark.parametrize("m", [4, 8])
+    def test_lanczos_partial_krylov(self, m):
+        n = 12
+        a = _RNG.standard_normal((n, n)).astype(np.float64)
+        a = (a + a.T) / 2
+        V, T = ht.linalg.lanczos(ht.array(a, split=0, dtype=ht.float64), m)
+        # V orthonormal and T tridiagonal-symmetric
+        vtv = V.numpy().T @ V.numpy()
+        np.testing.assert_allclose(vtv, np.eye(m), atol=1e-8)
+        t = T.numpy()
+        np.testing.assert_allclose(t, t.T, atol=1e-12)
+        # Krylov projection: V^T A V == T
+        np.testing.assert_allclose(V.numpy().T @ a @ V.numpy(), t, atol=1e-7)

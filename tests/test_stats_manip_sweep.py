@@ -115,7 +115,8 @@ class TestManipulationsSweep:
         v = np.tile(np.arange(5, dtype=np.float32), 9)
         x = ht.array(v, split=split)
         got = ht.unique(x, sorted=True)
-        np.testing.assert_array_equal(np.sort(got.numpy()), np.unique(v))
+        # order matters: sorted=True must return the ascending uniques
+        np.testing.assert_array_equal(got.numpy(), np.unique(v))
 
     @pytest.mark.parametrize("split", _SPLITS_2D)
     def test_moveaxis_swapaxes_rot90(self, split):
@@ -176,3 +177,9 @@ class TestReshapeEdges:
         r = x.reshape(0, 2, 2)
         assert r.shape == (0, 2, 2)
         assert r.numpy().shape == (0, 2, 2)
+
+    @pytest.mark.parametrize("target,ns", [((4, 0), 0), ((0, 8), 1), ((2, 0, 2), 0)])
+    def test_empty_reshape_any_split(self, target, ns):
+        x = ht.array(np.empty((0, 4), np.float32), split=0)
+        r = ht.reshape(x, target, new_split=ns)
+        assert r.shape == target
