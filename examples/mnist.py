@@ -37,7 +37,8 @@ def load_data(data_root):
         from heat_tpu.utils.data.mnist import MNISTDataset
 
         ds = MNISTDataset(data_root, train=True)
-        x = ds.data.reshape(len(ds.data), -1).astype(np.float32) / 255.0
+        # MNISTDataset already scales pixels to [0, 1]
+        x = np.asarray(ds.data).reshape(len(ds.data), -1).astype(np.float32)
         y = ds.targets.astype(np.int32)
         return ht.array(x[:8192], split=0), ht.array(y[:8192], split=0), 784, 10
     # offline fallback: separable 16-d blobs, one per class

@@ -16,6 +16,8 @@ sorts are lane-local XLA sorts.
 
 from __future__ import annotations
 
+import functools as _functools
+
 import numpy as np
 
 import jax
@@ -140,6 +142,25 @@ def column_stack(arrays: Sequence[DNDarray]) -> DNDarray:
     return _wrap(result, split, ref)
 
 
+@_functools.lru_cache(maxsize=1024)
+def _concat_program(comm, metas, axis, out_split, jdtype):
+    """One compiled program for concatenate: per-input unpad + cast →
+    concatenate → output pad, out-sharding pinned (the reference's split
+    harmonization + redistribution, manipulations.py:390, fused)."""
+    from . import _padding
+
+    def fn(*phys):
+        logicals = [
+            _padding.unpad(p_, gshape, split).astype(jnp.dtype(jdtype))
+            for p_, (gshape, split) in zip(phys, metas)
+        ]
+        r = jnp.concatenate(logicals, axis=axis)
+        return _padding.pad_logical(r, out_split, comm.size)
+
+    ndim = len(metas[0][0])
+    return jax.jit(fn, out_shardings=comm.sharding(ndim, out_split))
+
+
 def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
     """Join arrays along an existing axis (reference: manipulations.py:390
     — split harmonization + redistribution; here jnp.concatenate on the
@@ -155,8 +176,16 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
     for a in arrays[1:]:
         out_dtype = types.promote_types(out_dtype, a.dtype)
     jt = out_dtype.jax_type()
-    result = jnp.concatenate([a.larray.astype(jt) for a in arrays], axis=axis)
     split = next((a.split for a in arrays if a.split is not None), None)
+    total = sum(a.shape[axis] for a in arrays)
+    if split is not None and all(x.size != 0 for x in arrays):
+        out_shape = list(ref.shape)
+        out_shape[axis] = total
+        metas = tuple((a.gshape, a.split) for a in arrays)
+        prog = _concat_program(ref.comm, metas, axis, split, np.dtype(jt).name)
+        phys = prog(*[a._phys for a in arrays])
+        return DNDarray(phys, tuple(out_shape), out_dtype, split, ref.device, ref.comm)
+    result = jnp.concatenate([a.larray.astype(jt) for a in arrays], axis=axis)
     return _wrap(result, split, ref, dtype=out_dtype)
 
 
@@ -328,9 +357,6 @@ def repeat(a, repeats, axis: Optional[int] = None) -> DNDarray:
     else:
         split = a.split
     return _wrap(result, split, a, dtype=a.dtype)
-
-
-import functools as _functools
 
 
 @_functools.lru_cache(maxsize=1024)
@@ -711,3 +737,4 @@ from .communication import register_mesh_cache
 
 # entries bake mesh geometry: cleared when init_distributed rebuilds the world
 register_mesh_cache(_reshape_program)
+register_mesh_cache(_concat_program)

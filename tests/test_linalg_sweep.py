@@ -63,7 +63,6 @@ class TestFactorizationsSweep:
         d = _RNG.standard_normal((m, n)).astype(np.float32)
         q, r = ht.linalg.qr(ht.array(d, split=split))
         np.testing.assert_allclose(q.numpy() @ r.numpy(), d, rtol=2e-3, atol=2e-3)
-        k = min(m, n)
         np.testing.assert_allclose(
             q.numpy().T @ q.numpy(), np.eye(q.shape[1]), atol=2e-3
         )
