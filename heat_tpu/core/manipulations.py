@@ -177,10 +177,13 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
         out_dtype = types.promote_types(out_dtype, a.dtype)
     jt = out_dtype.jax_type()
     split = next((a.split for a in arrays if a.split is not None), None)
-    total = sum(a.shape[axis] for a in arrays)
-    if split is not None and all(x.size != 0 for x in arrays):
+    if (
+        split is not None
+        and all(x.ndim == ref.ndim for x in arrays)
+        and all(x.size != 0 for x in arrays)
+    ):
         out_shape = list(ref.shape)
-        out_shape[axis] = total
+        out_shape[axis] = sum(a.shape[axis] for a in arrays)
         metas = tuple((a.gshape, a.split) for a in arrays)
         prog = _concat_program(ref.comm, metas, axis, split, np.dtype(jt).name)
         phys = prog(*[a._phys for a in arrays])

@@ -1,0 +1,133 @@
+"""Breadth sweep for the random, io and nn surfaces (the reference's
+test_random.py / test_io.py / nn tests coverage shape)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu import nn as htnn
+
+
+class TestRandomSweep:
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_uniform_moments(self, split):
+        ht.random.seed(0)
+        x = ht.random.rand(20_000, split=split)
+        v = x.numpy()
+        assert 0.0 <= v.min() and v.max() < 1.0
+        assert abs(v.mean() - 0.5) < 0.02
+        assert abs(v.var() - 1 / 12) < 0.01
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_normal_moments(self, split):
+        ht.random.seed(1)
+        x = ht.random.randn(20_000, split=split)
+        v = x.numpy()
+        assert abs(v.mean()) < 0.05
+        assert abs(v.std() - 1.0) < 0.05
+
+    def test_randint_bounds_and_dtype(self):
+        ht.random.seed(2)
+        x = ht.random.randint(3, 17, (5_000,), split=0)
+        v = x.numpy()
+        assert v.min() >= 3 and v.max() < 17
+        assert x.dtype == ht.int32
+
+    def test_permutation_and_randperm(self):
+        ht.random.seed(3)
+        p = ht.random.randperm(97, comm=ht.get_comm())
+        np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(97))
+        q = ht.random.permutation(ht.arange(23, split=0))
+        np.testing.assert_array_equal(np.sort(q.numpy()), np.arange(23))
+
+    def test_seed_reproducibility_across_splits(self):
+        ht.random.seed(9)
+        a = ht.random.rand(31, split=0).numpy()
+        ht.random.seed(9)
+        b = ht.random.rand(31, split=None).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_aliases(self):
+        ht.random.seed(4)
+        for fn in (ht.random.random_sample, ht.random.ranf, ht.random.sample):
+            v = fn((8,))
+            assert v.shape == (8,)
+
+
+class TestIOSweep:
+    def test_save_load_extension_dispatch(self, tmp_path):
+        x = ht.arange(24, dtype=ht.float32, split=0).reshape((6, 4))
+        p = str(tmp_path / "a.h5")
+        ht.save(x, p, "data")
+        y = ht.load(p, "data", split=0)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+    def test_csv_header_lines_and_sep(self, tmp_path):
+        p = str(tmp_path / "d.csv")
+        with open(p, "w") as f:
+            f.write("# header one\n# header two\n")
+            for row in np.arange(12).reshape(4, 3):
+                f.write(",".join(str(float(v)) for v in row) + "\n")
+        x = ht.load_csv(p, header_lines=2, sep=",", split=0)
+        np.testing.assert_allclose(x.numpy(), np.arange(12).reshape(4, 3), rtol=1e-6)
+
+    def test_save_csv_roundtrip_sep(self, tmp_path):
+        d = np.random.default_rng(0).random((5, 3)).astype(np.float32)
+        p = str(tmp_path / "x.csv")
+        ht.save_csv(ht.array(d, split=0), p, sep=";", decimals=6)
+        y = ht.load_csv(p, sep=";", split=0)
+        np.testing.assert_allclose(y.numpy(), d, atol=1e-5)
+
+    def test_load_unknown_extension_raises(self, tmp_path):
+        with pytest.raises(ValueError):
+            ht.load(str(tmp_path / "x.xyz"), "d")
+
+
+class TestNNSweep:
+    def test_linear_matches_manual(self):
+        lin = htnn.Linear(6, 3)
+        params = lin.init(jax.random.key(0))
+        x = jnp.asarray(np.random.default_rng(1).standard_normal((10, 6)).astype(np.float32))
+        out = lin.apply(params, x)
+        leaves = jax.tree.leaves(params)
+        # y = x @ W (+ b): find the 2-d leaf as the weight
+        wmat = next(l for l in leaves if l.ndim == 2)
+        bvec = next((l for l in leaves if l.ndim == 1), None)
+        ref = x @ wmat + (bvec if bvec is not None else 0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+    def test_sequential_composes(self):
+        model = htnn.Sequential(htnn.Linear(4, 8), htnn.ReLU(), htnn.Linear(8, 2))
+        params = model.init(jax.random.key(0))
+        x = jnp.ones((5, 4), dtype=jnp.float32)
+        out = model.apply(params, x)
+        assert out.shape == (5, 2)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_functional_aliases(self):
+        x = jnp.asarray(np.linspace(-2, 2, 9, dtype=np.float32))
+        np.testing.assert_allclose(
+            np.asarray(htnn.functional.relu(x)), np.maximum(np.asarray(x), 0)
+        )
+        np.testing.assert_allclose(
+            np.asarray(htnn.functional.sigmoid(x)),
+            1 / (1 + np.exp(-np.asarray(x))),
+            rtol=1e-5,
+        )
+        s = np.asarray(htnn.functional.softmax(x))
+        assert abs(s.sum() - 1.0) < 1e-5
+
+    def test_dataparallel_forward_matches_single(self):
+        model = htnn.Sequential(htnn.Linear(6, 4), htnn.ReLU(), htnn.Linear(4, 3))
+        dp = htnn.DataParallel(model, key=0)
+        d = np.random.default_rng(2).standard_normal((16, 6)).astype(np.float32)
+        x_split = ht.array(d, split=0)
+        x_repl = ht.array(d)
+        np.testing.assert_allclose(
+            dp(x_split).numpy(), dp(x_repl).numpy(), rtol=2e-5, atol=2e-6
+        )
