@@ -2,13 +2,15 @@
 
 Parity with /root/reference/heat/sparse/__init__.py: ``DCSR_matrix``,
 ``sparse_csr_matrix``, ``sparse_add``/``sparse_mul``, ``to_dense``/
-``to_sparse``."""
+``to_sparse``. ``matmul`` (SpMV/SpMM) EXCEEDS the reference, whose
+sparse type has no multiplication."""
 
 from .dcsr_matrix import DCSR_matrix
 from .factories import sparse_csr_matrix
 from .arithmetics import add, mul
 from .arithmetics import add as sparse_add, mul as sparse_mul
 from .manipulations import to_dense, to_sparse
+from .linalg import matmul
 
 __all__ = [
     "DCSR_matrix",
@@ -19,4 +21,5 @@ __all__ = [
     "sparse_mul",
     "to_dense",
     "to_sparse",
+    "matmul",
 ]

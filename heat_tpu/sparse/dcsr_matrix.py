@@ -89,6 +89,12 @@ class DCSR_matrix:
     # ------------------------------------------------------------------ #
     # global components                                                  #
     # ------------------------------------------------------------------ #
+    def __matmul__(self, other):
+        """``A @ x`` — SpMV/SpMM (heat_tpu extension; see sparse.linalg)."""
+        from . import linalg as _slinalg
+
+        return _slinalg.matmul(self, other)
+
     @property
     def indptr(self) -> jax.Array:
         """Global indptr (reference dcsr_matrix.py:155: Allgather of local
@@ -110,6 +116,13 @@ class DCSR_matrix:
         return _padding.unpad(self.__data, (self.__gnnz,), 0 if self.__split == 0 else None)
 
     gdata = data
+
+    @property
+    def _phys_components(self):
+        """(indptr, physical indices, physical data) — padded nnz-sharded
+        arrays for compiled kernels (pad entries hold zeros: framework
+        invariant, contribution-free under segment_sum)."""
+        return self.__indptr, self.__indices, self.__data
 
     @property
     def larray(self):

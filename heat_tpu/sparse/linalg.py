@@ -1,0 +1,96 @@
+"""Sparse linear algebra: SpMV / SpMM for ``DCSR_matrix``.
+
+The reference's sparse package stops at elementwise ops; a TPU framework
+whose sparse type cannot multiply is a shell, so this EXCEEDS reference
+parity. The formulation is segment-sum based — the gather/segment-sum
+pair is XLA's native sparse-contraction idiom (what
+``jax.experimental.sparse`` BCOO lowers to) and runs on the sharded
+component arrays:
+
+    rows  = searchsorted(indptr, iota(nnz), 'right') - 1   (cached)
+    y     = segment_sum(data * x[indices], rows, m)
+
+For a matrix operand the multiply broadcasts over the dense columns.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from typing import Union
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = ["matmul"]
+
+
+@functools.lru_cache(maxsize=256)
+def _spmm_program(comm, m: int, nnz_phys: int, out_ndim: int, out_split, jdtype: str):
+    """(indptr, phys indices, phys data, x) -> y physical: one compiled
+    segment-sum SpMM over the PADDED nnz-sharded components, output
+    sharding pinned. Pad entries are contribution-free (data pad is zero
+    by framework invariant), so no unpad pass runs; jit retraces per
+    operand shape, so the dense column count needs no cache key."""
+    from ..core import _padding
+
+    def run(indptr, indices, data, x):
+        jt = jnp.dtype(jdtype)
+        rows = (
+            jnp.searchsorted(
+                indptr, jnp.arange(nnz_phys, dtype=indptr.dtype), side="right"
+            )
+            - 1
+        )
+        gathered = x.astype(jt)[indices]          # (nnz,) or (nnz, k)
+        if gathered.ndim == 1:
+            contrib = data.astype(jt) * gathered
+        else:
+            contrib = data.astype(jt)[:, None] * gathered
+        y = jax.ops.segment_sum(contrib, rows, num_segments=m)
+        return _padding.pad_logical(y, out_split, comm.size)
+
+    return jax.jit(run, out_shardings=comm.sharding(out_ndim, out_split))
+
+
+def matmul(A: DCSR_matrix, x: Union[DNDarray, jax.Array, np.ndarray]) -> DNDarray:
+    """``A @ x`` for a distributed CSR matrix and a dense vector/matrix.
+
+    Returns a DNDarray of shape (m,) or (m, k), split along axis 0 when
+    ``A`` is row-distributed (matching A's distribution rule).
+    """
+    if not isinstance(A, DCSR_matrix):
+        raise TypeError(f"A must be a DCSR_matrix, got {type(A)}")
+    if isinstance(x, DNDarray):
+        xarr = x.larray
+    else:
+        xarr = jnp.asarray(np.asarray(x)) if not isinstance(x, jax.Array) else x
+    if xarr.ndim not in (1, 2):
+        raise ValueError(f"dense operand must be 1-D or 2-D, got {xarr.ndim}-D")
+    m, n = A.shape
+    if xarr.shape[0] != n:
+        raise ValueError(
+            f"dimension mismatch: A is {A.shape}, dense operand has leading dim {xarr.shape[0]}"
+        )
+    out_dtype = types.promote_types(A.dtype, types.canonical_heat_type(xarr.dtype))
+    jt = out_dtype.jax_type()
+    comm = A.comm
+    split = 0 if A.split == 0 else None
+    gshape = (m,) if xarr.ndim == 1 else (m, int(xarr.shape[1]))
+    indptr, phys_indices, phys_data = A._phys_components
+    prog = _spmm_program(
+        comm, m, int(phys_indices.shape[0]), len(gshape), split, np.dtype(jt).name
+    )
+    phys = prog(indptr, phys_indices, phys_data, xarr)
+    return DNDarray(phys, gshape, out_dtype, split, A.device, comm)
+
+from ..core.communication import register_mesh_cache
+
+# entries bake mesh geometry: cleared when init_distributed rebuilds the world
+register_mesh_cache(_spmm_program)

@@ -239,3 +239,49 @@ class TestManipulations:
     def test_repr_smoke(self):
         s = sparse_csr_matrix(_ref_matrix(m=3, n=3), split=0)
         assert "indptr" in repr(s)
+
+
+class TestSparseMatmul:
+    """SpMV/SpMM (heat_tpu extension beyond reference parity — the
+    reference's sparse type has no multiplication)."""
+
+    def _mk(self, split, density=0.3, shape=(13, 9)):
+        import scipy.sparse as sp
+
+        rng = np.random.default_rng(0)
+        dense = ((rng.random(shape) < density) * rng.standard_normal(shape)).astype(np.float32)
+        return ht.sparse.sparse_csr_matrix(sp.csr_matrix(dense), split=split), dense
+
+    def test_spmv_matches_scipy(self):
+        for split in (0, None):
+            A, dense = self._mk(split)
+            x = np.random.default_rng(1).standard_normal(9).astype(np.float32)
+            y = A @ ht.array(x, split=0 if split == 0 else None)
+            np.testing.assert_allclose(y.numpy(), dense @ x, rtol=1e-5, atol=1e-6)
+            assert y.split == split
+
+    def test_spmm_matches_scipy(self):
+        A, dense = self._mk(0)
+        X = np.random.default_rng(2).standard_normal((9, 4)).astype(np.float32)
+        Y = ht.sparse.matmul(A, X)
+        np.testing.assert_allclose(Y.numpy(), dense @ X, rtol=1e-5, atol=1e-6)
+        assert Y.gshape == (13, 4)
+
+    def test_dtype_promotion_and_errors(self):
+        A, dense = self._mk(0)
+        xi = np.arange(9, dtype=np.int32)
+        y = A @ ht.array(xi)
+        np.testing.assert_allclose(y.numpy(), dense @ xi, rtol=1e-5, atol=1e-5)
+        with pytest.raises(ValueError):
+            ht.sparse.matmul(A, np.zeros(5, np.float32))
+        with pytest.raises(TypeError):
+            ht.sparse.matmul(dense, xi)
+
+    def test_empty_rows_and_all_zero(self):
+        import scipy.sparse as sp
+
+        dense = np.zeros((11, 6), np.float32)
+        dense[3, 2] = 5.0
+        A = ht.sparse.sparse_csr_matrix(sp.csr_matrix(dense), split=0)
+        x = np.ones(6, np.float32)
+        np.testing.assert_allclose((A @ ht.array(x)).numpy(), dense @ x)
