@@ -8,6 +8,8 @@ sharded matvec (one all-reduce when the sample axis is split).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -21,6 +23,50 @@ from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
 
 __all__ = ["Lasso"]
+
+
+@functools.lru_cache(maxsize=64)
+def _cd_program(m: int, max_iter: int):
+    """Whole coordinate-descent fit as ONE compiled program: per-fit
+    closures would recompile on every ``fit`` call, and baking lam/tol in
+    as constants would recompile per regularization value — they are
+    TRACED scalars, so a regularization-path sweep reuses one executable
+    (jit retraces per operand shape/dtype, so neither needs a key).
+    Sweeps run as a fori_loop over coordinates; convergence is a
+    while_loop with the tol test on device (a host check per sweep costs
+    a ~90 ms tunnel round trip)."""
+
+    def sweep(X, yarr, col_msq, lam, th):
+        def body(j, th):
+            resid = yarr - X @ th + X[:, j] * th[j]
+            rho = jnp.mean(X[:, j] * resid)
+            denom = jnp.maximum(col_msq[j], 1e-30)
+            unpenalized = rho / denom
+            penalized = jnp.where(
+                rho < -lam,
+                (rho + lam) / denom,
+                jnp.where(rho > lam, (rho - lam) / denom, 0.0),
+            )
+            new_j = jnp.where(j == 0, unpenalized, penalized)
+            return th.at[j].set(new_j)
+
+        return jax.lax.fori_loop(0, m, body, th)
+
+    def run(X, yarr, col_msq, lam, tol, theta0):
+        def cond(state):
+            it, th, diff = state
+            return (it < max_iter) & (diff >= tol)
+
+        def body(state):
+            it, th, _ = state
+            nt = sweep(X, yarr, col_msq, lam, th)
+            return (it + 1, nt, jnp.max(jnp.abs(nt - th)))
+
+        return jax.lax.while_loop(
+            cond, body, (0, theta0, jnp.asarray(jnp.inf, theta0.dtype))
+        )
+
+    return jax.jit(run)
 
 
 class Lasso(BaseEstimator, RegressionMixin):
@@ -92,33 +138,13 @@ class Lasso(BaseEstimator, RegressionMixin):
         # mean correlation against lam (reference lasso.py:121-172), so lam
         # is sample-size independent
         col_msq = jnp.mean(X * X, axis=0)
-        lam = self.__lam
-
-        @jax.jit
-        def sweep(theta):
-            def body(j, th):
-                resid = yarr - X @ th + X[:, j] * th[j]
-                rho = jnp.mean(X[:, j] * resid)
-                denom = jnp.maximum(col_msq[j], 1e-30)
-                unpenalized = rho / denom
-                penalized = jnp.where(
-                    rho < -lam,
-                    (rho + lam) / denom,
-                    jnp.where(rho > lam, (rho - lam) / denom, 0.0),
-                )
-                new_j = jnp.where(j == 0, unpenalized, penalized)
-                return th.at[j].set(new_j)
-
-            return jax.lax.fori_loop(0, m, body, theta)
-
-        n_iter = 0
-        for n_iter in range(1, self.max_iter + 1):
-            new_theta = sweep(theta)
-            diff = float(jnp.max(jnp.abs(new_theta - theta)))
-            theta = new_theta
-            if diff < self.tol:
-                break
-        self.n_iter = n_iter
+        prog = _cd_program(m, int(self.max_iter))
+        n_iter_dev, theta, _ = prog(
+            X, yarr, col_msq,
+            jnp.asarray(self.__lam, arr.dtype), jnp.asarray(self.tol, arr.dtype),
+            theta,
+        )
+        self.n_iter = int(n_iter_dev)
 
         from ..core import factories
 

@@ -78,6 +78,7 @@ class DCSR_matrix:
         self.__indptr = indptr
         self.__indices = indices
         self.__data = data
+        self.__rows_cache = None
         self.__gnnz = int(gnnz)
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = dtype
@@ -116,6 +117,19 @@ class DCSR_matrix:
         return _padding.unpad(self.__data, (self.__gnnz,), 0 if self.__split == 0 else None)
 
     gdata = data
+
+    @property
+    def _rows(self) -> jax.Array:
+        """COO row index per (padded) stored element — constant per
+        matrix, derived once and cached (iterative SpMV would otherwise
+        re-pay an O(nnz log m) searchsorted per multiply)."""
+        if self.__rows_cache is None:
+            from ._operations import rows_from_indptr
+
+            self.__rows_cache = rows_from_indptr(
+                self.__indptr, int(self.__indices.shape[0])
+            )
+        return self.__rows_cache
 
     @property
     def _phys_components(self):

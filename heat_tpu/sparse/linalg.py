@@ -32,22 +32,18 @@ __all__ = ["matmul"]
 
 
 @functools.lru_cache(maxsize=256)
-def _spmm_program(comm, m: int, nnz_phys: int, out_ndim: int, out_split, jdtype: str):
-    """(indptr, phys indices, phys data, x) -> y physical: one compiled
+def _spmm_program(comm, m: int, out_ndim: int, out_split, jdtype: str):
+    """(rows, phys indices, phys data, x) -> y physical: one compiled
     segment-sum SpMM over the PADDED nnz-sharded components, output
     sharding pinned. Pad entries are contribution-free (data pad is zero
-    by framework invariant), so no unpad pass runs; jit retraces per
-    operand shape, so the dense column count needs no cache key."""
+    by framework invariant), so no unpad pass runs; ``rows`` is the
+    per-matrix cached COO row map (pad rows map past m and are dropped
+    by segment_sum). jit retraces per operand shape, so neither nnz nor
+    the dense column count needs a cache key."""
     from ..core import _padding
 
-    def run(indptr, indices, data, x):
+    def run(rows, indices, data, x):
         jt = jnp.dtype(jdtype)
-        rows = (
-            jnp.searchsorted(
-                indptr, jnp.arange(nnz_phys, dtype=indptr.dtype), side="right"
-            )
-            - 1
-        )
         gathered = x.astype(jt)[indices]          # (nnz,) or (nnz, k)
         if gathered.ndim == 1:
             contrib = data.astype(jt) * gathered
@@ -83,11 +79,9 @@ def matmul(A: DCSR_matrix, x: Union[DNDarray, jax.Array, np.ndarray]) -> DNDarra
     comm = A.comm
     split = 0 if A.split == 0 else None
     gshape = (m,) if xarr.ndim == 1 else (m, int(xarr.shape[1]))
-    indptr, phys_indices, phys_data = A._phys_components
-    prog = _spmm_program(
-        comm, m, int(phys_indices.shape[0]), len(gshape), split, np.dtype(jt).name
-    )
-    phys = prog(indptr, phys_indices, phys_data, xarr)
+    _, phys_indices, phys_data = A._phys_components
+    prog = _spmm_program(comm, m, len(gshape), split, np.dtype(jt).name)
+    phys = prog(A._rows, phys_indices, phys_data, xarr)
     return DNDarray(phys, gshape, out_dtype, split, A.device, comm)
 
 from ..core.communication import register_mesh_cache
