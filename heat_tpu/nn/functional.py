@@ -23,6 +23,34 @@ elu = jax.nn.elu
 one_hot = jax.nn.one_hot
 
 
+def scaled_dot_product_attention(query, key, value, attn_mask=None, is_causal=False, scale=None):
+    """torch-parity alias (torch.nn.functional.scaled_dot_product_attention)
+    over the framework's attention: DNDarray operands route through
+    ``nn.attention.ring_attention`` (sequence-parallel when the seq axis is
+    split, blocked flash-style otherwise). ``attn_mask`` is not supported —
+    use ``is_causal`` or mask scores explicitly."""
+    from .attention import ring_attention
+    from ..core.dndarray import DNDarray
+
+    if attn_mask is not None:
+        raise NotImplementedError("attn_mask is not supported; use is_causal")
+    if isinstance(query, DNDarray):
+        return ring_attention(query, key, value, causal=is_causal, scale=scale)
+    # raw jax arrays: the same blocked flash-style kernel the DNDarray
+    # route uses on a single device (no (Sq, Sk) score materialization)
+    import numpy as _np
+
+    from .attention import _blocked_attention_program
+
+    if scale is None:
+        scale = 1.0 / float(_np.sqrt(query.shape[-1]))
+    prog = _blocked_attention_program(
+        tuple(query.shape), tuple(key.shape), tuple(value.shape),
+        bool(is_causal), float(scale), _np.dtype(query.dtype).name,
+    )
+    return prog(query, key, value)
+
+
 def linear(x, weight, bias=None):
     """y = x W (+ b) with weight stored (in, out) — see nn.Linear."""
     y = x @ weight

@@ -126,9 +126,14 @@ class DCSR_matrix:
         if self.__rows_cache is None:
             from ._operations import rows_from_indptr
 
-            self.__rows_cache = rows_from_indptr(
-                self.__indptr, int(self.__indices.shape[0])
-            )
+            rows = rows_from_indptr(self.__indptr, int(self.__indices.shape[0]))
+            # keep the nnz-axis layout of indices/data: an unsharded row
+            # map would add O(gnnz) resident bytes per device
+            if self.__split == 0:
+                rows = jax.device_put(
+                    rows, self.__comm.sharding(1, 0)
+                )
+            self.__rows_cache = rows
         return self.__rows_cache
 
     @property

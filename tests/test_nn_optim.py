@@ -309,3 +309,30 @@ class TestRingAttention:
         assert out.shape == (S, 6)
         ref = self._dense(qn, kn, vn, False, 1 / np.sqrt(4))
         np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-5)
+
+
+class TestSDPAAlias:
+    """torch-parity F.scaled_dot_product_attention over ring/blocked
+    attention (reference functional is a torch passthrough)."""
+
+    def test_matches_oracle_both_routes(self):
+        from heat_tpu.nn import functional as F
+
+        rng = np.random.default_rng(0)
+        S, D = 33, 8
+        qn, kn, vn = (rng.standard_normal((S, D)).astype(np.float32) for _ in range(3))
+        s_ = qn @ kn.T / np.sqrt(D)
+        s_ = np.where(np.tril(np.ones((S, S), bool)), s_, -1e30)
+        p_ = np.exp(s_ - s_.max(-1, keepdims=True)); p_ /= p_.sum(-1, keepdims=True)
+        ref = p_ @ vn
+        out = F.scaled_dot_product_attention(
+            ht.array(qn, split=0), ht.array(kn, split=0), ht.array(vn, split=0),
+            is_causal=True,
+        )
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-5)
+        out2 = F.scaled_dot_product_attention(
+            jnp.asarray(qn), jnp.asarray(kn), jnp.asarray(vn), is_causal=True
+        )
+        np.testing.assert_allclose(np.asarray(out2), ref, rtol=2e-4, atol=2e-5)
+        with pytest.raises(NotImplementedError):
+            F.scaled_dot_product_attention(jnp.asarray(qn), jnp.asarray(kn), jnp.asarray(vn), attn_mask=1)
