@@ -162,6 +162,24 @@ def _blocked_attention_program(
     return jax.jit(run)
 
 
+def _single_device_attention(qa, ka, va, causal: bool, scale):
+    """Shared single-device flash attention on raw jax arrays: non-inexact
+    dtypes promote to float32, the default scale is 1/sqrt(d), and the
+    blocked program runs — the ONE code path behind both ring_attention's
+    single-device branch and functional.scaled_dot_product_attention's
+    raw-array route (divergence here would mean same inputs, different
+    numerics depending on the array wrapper)."""
+    jt = qa.dtype if jnp.issubdtype(qa.dtype, jnp.inexact) else jnp.dtype(jnp.float32)
+    qa, ka, va = (t.astype(jt) for t in (qa, ka, va))
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(qa.shape[-1]))
+    prog = _blocked_attention_program(
+        tuple(qa.shape), tuple(ka.shape), tuple(va.shape),
+        bool(causal), float(scale), np.dtype(jt).name,
+    )
+    return prog(qa, ka, va)
+
+
 def ring_attention(
     q: DNDarray,
     k: DNDarray,
@@ -208,12 +226,10 @@ def ring_attention(
         # single device / replicated q: blocked flash-style attention —
         # the dense formulation would materialize the (B, H, S, S) score
         # tensor (2 GB at S=4k), the blocked scan keeps it one tile
-        qa, ka, va = (t.larray.astype(jt) for t in (q, k, v))
-        prog = _blocked_attention_program(
-            tuple(qa.shape), tuple(ka.shape), tuple(va.shape),
-            bool(causal), float(scale), np.dtype(jt).name,
+        out = _single_device_attention(
+            q.larray.astype(jt), k.larray.astype(jt), v.larray.astype(jt),
+            causal, scale,
         )
-        out = prog(qa, ka, va)
         return DNDarray(
             comm.shard(out, q.split), out_gshape, dtype, q.split, q.device, comm
         )

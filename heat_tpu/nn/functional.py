@@ -29,26 +29,27 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, is_causal=Fa
     ``nn.attention.ring_attention`` (sequence-parallel when the seq axis is
     split, blocked flash-style otherwise). ``attn_mask`` is not supported —
     use ``is_causal`` or mask scores explicitly."""
-    from .attention import ring_attention
+    from .attention import _single_device_attention, ring_attention
     from ..core.dndarray import DNDarray
 
     if attn_mask is not None:
         raise NotImplementedError("attn_mask is not supported; use is_causal")
-    if isinstance(query, DNDarray):
+    ops = (query, key, value)
+    if any(isinstance(t, DNDarray) for t in ops):
+        # mixed operands: lift raw arrays onto the DNDarray operand's comm
+        # so the whole call takes ONE route with consistent diagnostics
+        ref = next(t for t in ops if isinstance(t, DNDarray))
+        from ..core import factories
+
+        query, key, value = (
+            t if isinstance(t, DNDarray)
+            else factories.array(t, comm=ref.comm, device=ref.device)
+            for t in ops
+        )
         return ring_attention(query, key, value, causal=is_causal, scale=scale)
-    # raw jax arrays: the same blocked flash-style kernel the DNDarray
-    # route uses on a single device (no (Sq, Sk) score materialization)
-    import numpy as _np
-
-    from .attention import _blocked_attention_program
-
-    if scale is None:
-        scale = 1.0 / float(_np.sqrt(query.shape[-1]))
-    prog = _blocked_attention_program(
-        tuple(query.shape), tuple(key.shape), tuple(value.shape),
-        bool(is_causal), float(scale), _np.dtype(query.dtype).name,
-    )
-    return prog(query, key, value)
+    # raw jax arrays: the same single-device kernel the DNDarray route
+    # uses (shared helper: promotion, default scale, blocked program)
+    return _single_device_attention(query, key, value, bool(is_causal), scale)
 
 
 def linear(x, weight, bias=None):

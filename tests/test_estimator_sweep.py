@@ -1,0 +1,115 @@
+"""Estimator breadth sweep against scikit-learn oracles across splits —
+the reference validates its estimator layer the same way
+(classification/tests, naive_bayes/tests, preprocessing/tests)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from sklearn import naive_bayes as sknb
+from sklearn import neighbors as sknn
+from sklearn import preprocessing as skpp
+
+_RNG = np.random.default_rng(3)
+_X = _RNG.standard_normal((45, 6)).astype(np.float32)
+_CENTERS = _RNG.standard_normal((3, 6)).astype(np.float32) * 4
+_Y = _RNG.integers(0, 3, 45).astype(np.int32)
+_XC = (_CENTERS[_Y] + _X).astype(np.float32)  # separable blobs
+_XT = (_CENTERS[_RNG.integers(0, 3, 12)] + _RNG.standard_normal((12, 6))).astype(np.float32)
+
+
+class TestGaussianNBSweep:
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_fit_predict_matches_sklearn(self, split):
+        ref = sknb.GaussianNB().fit(_XC, _Y)
+        est = ht.naive_bayes.GaussianNB().fit(
+            ht.array(_XC, split=split), ht.array(_Y, split=split)
+        )
+        np.testing.assert_array_equal(
+            est.predict(ht.array(_XT, split=split)).numpy(), ref.predict(_XT)
+        )
+        np.testing.assert_allclose(
+            np.asarray(est.theta_.numpy()), ref.theta_, rtol=1e-4, atol=1e-5
+        )
+
+    def test_partial_fit_equals_one_shot(self):
+        est1 = ht.naive_bayes.GaussianNB().fit(ht.array(_XC, split=0), ht.array(_Y, split=0))
+        est2 = ht.naive_bayes.GaussianNB()
+        classes = ht.array(np.unique(_Y))
+        est2.partial_fit(ht.array(_XC[:20], split=0), ht.array(_Y[:20], split=0), classes=classes)
+        est2.partial_fit(ht.array(_XC[20:], split=0), ht.array(_Y[20:], split=0))
+        np.testing.assert_allclose(
+            np.asarray(est1.theta_.numpy()), np.asarray(est2.theta_.numpy()),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+class TestKNNSweep:
+    @pytest.mark.parametrize("split", [None, 0])
+    @pytest.mark.parametrize("k", [1, 5])
+    def test_predict_matches_sklearn(self, split, k):
+        ref = sknn.KNeighborsClassifier(n_neighbors=k).fit(_XC, _Y)
+        est = ht.classification.KNeighborsClassifier(n_neighbors=k)
+        est.fit(ht.array(_XC, split=split), ht.array(_Y, split=split))
+        got = est.predict(ht.array(_XT, split=split)).numpy().ravel()
+        # blobs are well separated: labels must agree exactly
+        np.testing.assert_array_equal(got, ref.predict(_XT))
+
+
+class TestPreprocessingSweep:
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_standard_scaler(self, split):
+        ref = skpp.StandardScaler().fit_transform(_X)
+        got = ht.preprocessing.StandardScaler().fit_transform(ht.array(_X, split=split))
+        np.testing.assert_allclose(got.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_minmax_scaler(self, split):
+        ref = skpp.MinMaxScaler().fit_transform(_X)
+        got = ht.preprocessing.MinMaxScaler().fit_transform(ht.array(_X, split=split))
+        np.testing.assert_allclose(got.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_maxabs_robust_normalizer(self, split):
+        x = ht.array(_X, split=split)
+        np.testing.assert_allclose(
+            ht.preprocessing.MaxAbsScaler().fit_transform(x).numpy(),
+            skpp.MaxAbsScaler().fit_transform(_X),
+            rtol=2e-4, atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            ht.preprocessing.RobustScaler().fit_transform(x).numpy(),
+            skpp.RobustScaler().fit_transform(_X),
+            rtol=2e-3, atol=2e-3,
+        )
+        np.testing.assert_allclose(
+            ht.preprocessing.Normalizer().fit_transform(x).numpy(),
+            skpp.Normalizer().fit_transform(_X),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_inverse_transform_roundtrip(self):
+        sc = ht.preprocessing.StandardScaler()
+        x = ht.array(_X, split=0)
+        z = sc.fit_transform(x)
+        back = sc.inverse_transform(z)
+        np.testing.assert_allclose(back.numpy(), _X, rtol=1e-4, atol=1e-4)
+
+
+class TestLassoSweep:
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_sparse_recovery(self, split):
+        rng = np.random.default_rng(0)
+        n, m = 400, 12
+        X = rng.standard_normal((n, m)).astype(np.float32)
+        beta = np.zeros(m, np.float32)
+        beta[[1, 4]] = [2.0, -3.0]
+        y = X @ beta + 0.01 * rng.standard_normal(n).astype(np.float32)
+        est = ht.regression.Lasso(lam=0.05, max_iter=200)
+        est.fit(ht.array(X, split=split), ht.array(y, split=split))
+        coef = np.asarray(est.coef_.numpy()).ravel()
+        assert abs(coef[1] - 2.0) < 0.15
+        assert abs(coef[4] + 3.0) < 0.15
+        others = np.delete(coef, [1, 4])
+        assert np.max(np.abs(others)) < 0.1
