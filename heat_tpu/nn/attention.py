@@ -226,10 +226,9 @@ def ring_attention(
         # single device / replicated q: blocked flash-style attention —
         # the dense formulation would materialize the (B, H, S, S) score
         # tensor (2 GB at S=4k), the blocked scan keeps it one tile
-        out = _single_device_attention(
-            q.larray.astype(jt), k.larray.astype(jt), v.larray.astype(jt),
-            causal, scale,
-        )
+        # raw logical arrays: the helper owns promotion (same rule that
+        # produced jt), so its policy is authoritative for BOTH routes
+        out = _single_device_attention(q.larray, k.larray, v.larray, causal, scale)
         return DNDarray(
             comm.shard(out, q.split), out_gshape, dtype, q.split, q.device, comm
         )

@@ -113,3 +113,37 @@ class TestLassoSweep:
         assert abs(coef[4] + 3.0) < 0.15
         others = np.delete(coef, [1, 4])
         assert np.max(np.abs(others)) < 0.1
+
+
+class TestGraphSpectralSweep:
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_laplacian_simple_vs_scipy(self, split):
+        from scipy.sparse import csgraph
+
+        rng = np.random.default_rng(1)
+        pts = rng.standard_normal((17, 3)).astype(np.float32)
+        # fully-connected similarity graph; simple L = D - W
+        lap = ht.graph.Laplacian(
+            lambda x: ht.spatial.rbf(x, sigma=1.0), definition="simple",
+            mode="fully_connected",
+        )
+        L = lap.construct(ht.array(pts, split=split))
+        from scipy.spatial.distance import cdist as scd
+
+        W = np.exp(-(scd(pts, pts) ** 2) / 2.0).astype(np.float64)
+        np.fill_diagonal(W, 0.0)
+        ref = csgraph.laplacian(W, normed=False)
+        np.testing.assert_allclose(np.asarray(L.numpy(), np.float64), ref, rtol=2e-3, atol=2e-3)
+
+    def test_spectral_separates_blobs(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((24, 2)).astype(np.float32) * 0.2
+        b = rng.standard_normal((24, 2)).astype(np.float32) * 0.2 + 5.0
+        pts = np.concatenate([a, b])
+        est = ht.cluster.Spectral(n_clusters=2, gamma=1.0, n_lanczos=20)
+        est.fit(ht.array(pts, split=0))
+        labels = est.labels_.numpy().ravel()
+        # the two blobs must land in different clusters
+        assert len(set(labels[:24])) == 1
+        assert len(set(labels[24:])) == 1
+        assert labels[0] != labels[-1]
