@@ -170,7 +170,7 @@ def _binary_callable(op, comm, out_ndim, split, n, pext, cast, scalar1, scalar2,
             r = _mask_tail(r, split, n)
         return r
 
-    return jax.jit(fn, out_shardings=comm.sharding(out_ndim, split))
+    return comm.jit_sharded(fn, out_ndim, split)
 
 
 @functools.lru_cache(maxsize=4096)
@@ -185,7 +185,7 @@ def _unary_callable(op, comm, ndim, split, n, pext, cast, static_kw, dyn_names):
             r = _mask_tail(r, split, n)
         return r
 
-    return jax.jit(fn, out_shardings=comm.sharding(ndim, split))
+    return comm.jit_sharded(fn, ndim, split)
 
 
 @functools.lru_cache(maxsize=4096)
@@ -200,7 +200,7 @@ def _reduce_callable(op, comm, split, n, pext, axes, keepdims, neutral, out_ndim
             r = _mask_tail(r, out_split, out_n)
         return r
 
-    return jax.jit(fn, out_shardings=comm.sharding(out_ndim, out_split))
+    return comm.jit_sharded(fn, out_ndim, out_split)
 
 
 @functools.lru_cache(maxsize=1024)
@@ -213,7 +213,7 @@ def _cum_callable(op, comm, ndim, split, n, pext, axis, cast):
             r = _mask_tail(r, split, n)
         return r
 
-    return jax.jit(fn, out_shardings=comm.sharding(ndim, split))
+    return comm.jit_sharded(fn, ndim, split)
 
 
 @functools.lru_cache(maxsize=4096)

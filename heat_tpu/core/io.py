@@ -4,10 +4,10 @@ API parity with /root/reference/heat/core/io.py (``load`` :671 dispatching
 by extension :1082-1133, ``load_hdf5`` :57, ``save_hdf5`` :166,
 ``load_csv`` :722, ``save_csv`` :948, ``supports_hdf5``/``supports_netcdf``).
 The reference reads per-rank hyperslabs (each rank its ``comm.chunk``); a
-single controller reads the file once and lays the array onto the mesh —
-in multi-process mode each host reads its slab and the global array is
-assembled via ``jax.make_array_from_process_local_data``. netCDF support
-is gated on the library being present (same as the reference).
+single controller reads one slab per device and stitches the global array
+with ``jax.make_array_from_single_device_arrays`` — in multi-process mode
+each host reads only its addressable devices' slabs. netCDF support is
+gated on the library being present (same as the reference).
 """
 
 from __future__ import annotations
@@ -80,16 +80,8 @@ def _assemble_sharded(read_slab, gshape, dtype, split, device, comm) -> DNDarray
     split = sanitize_axis(gshape, split)
     jdt = np.dtype(dtype.jax_type()) if dtype is not types.bfloat16 else np.float32
 
-    if jax.process_count() > 1:
-        # per-host ingest of only the addressable slabs lands with the
-        # multi-host runtime; fail loudly rather than device_put to a
-        # non-addressable device
-        raise NotImplementedError(
-            "multi-host hdf5 ingest lands with the multi-host runtime "
-            "(reference per-rank path: io.py:57)"
-        )
-
     if split is None:
+        # replicated: every host reads the full array once
         data = np.asarray(read_slab(tuple(slice(0, s) for s in gshape)), dtype=jdt)
         return _from_numpy(data, dtype, None, device, comm)
 
@@ -99,7 +91,13 @@ def _assemble_sharded(read_slab, gshape, dtype, split, device, comm) -> DNDarray
     shards = []
     blk_shape = list(gshape)
     blk_shape[split] = block
+    proc = jax.process_index()
     for r, dev in enumerate(comm.devices):
+        if dev.process_index != proc:
+            # multi-host: another host reads this slab — the reference's
+            # per-rank hyperslab pattern (io.py:57); each process passes
+            # only its addressable shards to make_array_from_single_device_arrays
+            continue
         start = r * block
         stop = min(start + block, n)
         if stop > start:
@@ -214,10 +212,20 @@ if __NETCDF:
     __all__.extend(["load_netcdf", "save_netcdf"])
 
     def load_netcdf(path, variable, dtype=types.float32, split=None, device=None, comm=None, **kwargs):
-        """Load a variable from a netCDF file (reference: io.py:283)."""
+        """Load a variable from a netCDF file (reference: io.py:283 — one
+        hyperslab per rank). Split loads read one slab per device; the
+        global array is never materialized on the host."""
         with netCDF4.Dataset(path, "r") as handle:
-            data = np.asarray(handle.variables[variable][...])
-        return _from_numpy(data, types.canonical_heat_type(dtype), split, device, comm)
+            var = handle.variables[variable]
+            gshape = tuple(var.shape)
+            return _assemble_sharded(
+                lambda sl: np.asarray(var[sl]),
+                gshape,
+                types.canonical_heat_type(dtype),
+                split,
+                device,
+                comm,
+            )
 
     def save_netcdf(
         data,

@@ -158,7 +158,7 @@ def _concat_program(comm, metas, axis, out_split, jdtype):
         return _padding.pad_logical(r, out_split, comm.size)
 
     ndim = len(metas[0][0])
-    return jax.jit(fn, out_shardings=comm.sharding(ndim, out_split))
+    return comm.jit_sharded(fn, ndim, out_split)
 
 
 def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
@@ -376,7 +376,7 @@ def _reshape_program(comm, in_gshape, in_split, out_shape, out_split):
         r = jnp.reshape(logical, out_shape)
         return _padding.pad_logical(r, out_split, comm.size)
 
-    return jax.jit(fn, out_shardings=comm.sharding(len(out_shape), out_split))
+    return comm.jit_sharded(fn, len(out_shape), out_split)
 
 
 def reshape(a: DNDarray, *shape, **kwargs) -> DNDarray:

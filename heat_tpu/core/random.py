@@ -121,6 +121,10 @@ def _cached_sampler(mesh, axis_name: str, op_key: str, shape, jdtype: str, split
             raise ValueError(op_key)
         return _padding.pad_logical(logical, split, size)
 
+    # build() has NO committed array inputs (the PRNG key is uncommitted),
+    # so out_shardings is what pins placement — it must stay even on a
+    # 1-device mesh (a .cpu() comm or Split sub-communicator is not the
+    # default device); creation dispatch is not a hot path
     return jax.jit(build, out_shardings=sharding)
 
 

@@ -52,7 +52,7 @@ def _spmm_program(comm, m: int, out_ndim: int, out_split, jdtype: str):
         y = jax.ops.segment_sum(contrib, rows, num_segments=m)
         return _padding.pad_logical(y, out_split, comm.size)
 
-    return jax.jit(run, out_shardings=comm.sharding(out_ndim, out_split))
+    return comm.jit_sharded(run, out_ndim, out_split)
 
 
 def matmul(A: DCSR_matrix, x: Union[DNDarray, jax.Array, np.ndarray]) -> DNDarray:

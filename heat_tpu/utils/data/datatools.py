@@ -37,23 +37,16 @@ __all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_permute(mesh, axis_name: str, shape, jdtype: str, split):
+def _cached_permute(comm, ndim: int, jdtype: str, split):
     """Jitted global permutation along axis 0, sharding preserved — the
     collective replacement for the reference's Isend/Irecv half-ring +
-    local randperm (datatools.py:246-343)."""
-    from jax.sharding import NamedSharding, PartitionSpec
+    local randperm (datatools.py:246-343). ``x`` is committed, so
+    ``jit_sharded``'s one-device fast path applies."""
 
-    if split is None:
-        spec = PartitionSpec()
-    else:
-        spec = PartitionSpec(*(axis_name if i == split else None for i in range(len(shape))))
-    sharding = NamedSharding(mesh, spec)
-
-    @functools.partial(jax.jit, out_shardings=sharding)
     def permute(x, perm):
         return jnp.take(x, perm, axis=0)
 
-    return permute
+    return comm.jit_sharded(permute, ndim, split)
 
 
 def _global_shuffle(array: DNDarray, perm: jax.Array) -> DNDarray:
@@ -63,9 +56,8 @@ def _global_shuffle(array: DNDarray, perm: jax.Array) -> DNDarray:
     invariant."""
     phys = array._phys
     permute = _cached_permute(
-        array.comm.mesh,
-        array.comm.axis_name,
-        tuple(phys.shape),
+        array.comm,
+        phys.ndim,
         np.dtype(phys.dtype).name,
         array.split,
     )

@@ -138,3 +138,37 @@ class TestCommunicatorManagement(TestCase):
         devs = {s.device for s in x._phys.addressable_shards}
         assert devs == set(sub.devices)
         assert int(ht.sum(x)) == sum(range(sub.size * 2))
+
+
+class TestSingleDevicePlacement:
+    """Zero-input jitted builders must pin placement even on a 1-device
+    mesh — a Split sub-communicator's device is not the default device
+    (regression: the single-chip dispatch fast path must not apply to
+    factories/random, whose programs have no committed array inputs)."""
+
+    def test_factory_on_size1_subcomm_lands_on_its_device(self):
+        comm = ht.get_comm()
+        if comm.size < 2:
+            pytest.skip("needs >1 device")
+        # one group per device → every sub-communicator has size 1
+        groups = comm.Split(list(range(comm.size)))
+        sub = groups[comm.size - 1]  # a NON-default device
+        assert sub.size == 1
+        target = set(sub.devices)
+        for arr in (
+            ht.zeros((5,), comm=sub),
+            ht.arange(5, comm=sub),
+            ht.random.randn(5, comm=sub),
+        ):
+            devs = {s.device for s in arr._phys.addressable_shards}
+            assert devs == target, f"landed on {devs}, expected {target}"
+
+    def test_ops_on_size1_subcomm_stay_on_its_device(self):
+        comm = ht.get_comm()
+        if comm.size < 2:
+            pytest.skip("needs >1 device")
+        sub = comm.Split(list(range(comm.size)))[comm.size - 1]
+        x = ht.arange(7, dtype=ht.float32, comm=sub)
+        y = ht.exp(x * 2.0 + x)  # committed inputs pin the fast-path programs
+        devs = {s.device for s in y._phys.addressable_shards}
+        assert devs == set(sub.devices)
