@@ -55,19 +55,31 @@ def _best_of_amortized(fn, sync, reps: int = 3, inner: int = 4, floor: float = 0
     Over the remote-execution tunnel a single scalar read-back costs
     ~90 ms — without amortization every sub-90ms workload reads as 90 ms.
     """
-    sync(fn())  # warmup / compile
-    best = float("inf")
+    return _best_of_amortized_group({"x": fn}, sync, reps=reps, inner=inner, floor=floor)["x"]
+
+
+def _best_of_amortized_group(fns: dict, sync, reps: int = 6, inner: int = 16, floor: float = 0.0) -> dict:
+    """Amortized timing for a GROUP of directly-compared workloads,
+    interleaved within the same rep loop so every member sees the same
+    tunnel weather — back-to-back separate measurements over the remote
+    tunnel can differ 5-10x from drift alone, which fabricates ratios.
+    """
+    for fn in fns.values():
+        sync(fn())  # warmup / compile
+    best = {k: float("inf") for k in fns}
     for _ in range(reps):
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(inner):
-            out = fn()
-        sync(out)
-        best = min(best, time.perf_counter() - t0)
-    per_op = (best - floor) / inner
-    if per_op <= 0:
-        per_op = best / inner
-    return per_op
+        for k, fn in fns.items():
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(inner):
+                out = fn()
+            sync(out)
+            best[k] = min(best[k], time.perf_counter() - t0)
+    out = {}
+    for k, b in best.items():
+        per_op = (b - floor) / inner
+        out[k] = per_op if per_op > 0 else b / inner
+    return out
 
 
 # --------------------------------------------------------------------- #
@@ -163,24 +175,24 @@ def measure_heat_tpu() -> dict:
 
     a = ht.random.random((N_MATMUL, N_MATMUL), split=0)
     b = ht.random.random((N_MATMUL, N_MATMUL), split=0)
-    out["matmul"] = amortized(lambda: ht.matmul(a, b), inner=32)
+    out["matmul"] = amortized(lambda: ht.matmul(a, b), reps=6, inner=32)
     a1 = a.resplit(1); b1 = b.resplit(1)
-    out["matmul_split1"] = amortized(lambda: ht.matmul(a1, b1), inner=32)
+    out["matmul_split1"] = amortized(lambda: ht.matmul(a1, b1), reps=6, inner=32)
     del a, b, a1, b1
 
     c0 = ht.random.random((N_QR, N_QR), split=0)
-    out["qr"] = amortized(lambda: ht.linalg.qr(c0)[0], reps=2, inner=8)
+    out["qr"] = amortized(lambda: ht.linalg.qr(c0)[0], reps=5, inner=8)
     del c0
 
     d = ht.random.random((HSVD_M, HSVD_N), split=0)
-    out["hsvd"] = amortized(lambda: ht.linalg.hsvd_rank(d, HSVD_R)[0], reps=3, inner=16)
+    out["hsvd"] = amortized(lambda: ht.linalg.hsvd_rank(d, HSVD_R)[0], reps=8, inner=16)
     del d
 
     from heat_tpu.cluster.kmeans import _lloyd_step
     x = ht.random.randn(KM_N, KM_D, split=0)
     cent = x.larray[:KM_K]
     step = _lloyd_step(KM_K, tuple(x.larray.shape), np.dtype(x.larray.dtype).name)
-    out["kmeans_iter"] = amortized(lambda: step(x.larray, cent)[0], inner=32)
+    out["kmeans_iter"] = amortized(lambda: step(x.larray, cent)[0], reps=6, inner=32)
     del x, cent
 
     # cb cluster config: full fit on 4x5000 spherical samples, kmeans++
@@ -229,12 +241,20 @@ def measure_heat_tpu() -> dict:
     import jax.numpy as jnp
     e = ht.random.randn(4_000_001, split=0)
     phys = e._phys
-    out["op_chain"] = amortized(lambda: ht.exp(ht.sin(e) * 2.0 + e), reps=5, inner=32)
-    # raw unfused jnp (same 3 dispatches): isolates the WRAPPER overhead
-    out["op_chain_raw_jnp"] = amortized(lambda: jnp.exp(jnp.sin(phys) * 2.0 + phys), reps=5, inner=32)
-    # single fused program: the fusion gap any 3-call chain pays
     fused = jax.jit(lambda v: jnp.exp(jnp.sin(v) * 2.0 + v))
-    out["op_chain_fused_jnp"] = amortized(lambda: fused(phys), reps=5, inner=32)
+    chain = _best_of_amortized_group(
+        {
+            "ht": lambda: ht.exp(ht.sin(e) * 2.0 + e),
+            # raw unfused jnp (same 3 dispatches): isolates the WRAPPER overhead
+            "raw": lambda: jnp.exp(jnp.sin(phys) * 2.0 + phys),
+            # single fused program: the fusion gap any 3-call chain pays
+            "fused": lambda: fused(phys),
+        },
+        sync, reps=6, inner=32, floor=floor,
+    )
+    out["op_chain"] = chain["ht"]
+    out["op_chain_raw_jnp"] = chain["raw"]
+    out["op_chain_fused_jnp"] = chain["fused"]
     del e, phys
 
     return out

@@ -162,6 +162,97 @@ def _blocked_attention_program(
     return jax.jit(run)
 
 
+# set only on import-level failure (kernel module unavailable); a shape
+# whose kernel cannot compile is cached as None per-signature instead
+_PALLAS_ATTENTION_UNAVAILABLE = False
+
+
+@functools.lru_cache(maxsize=64)
+def _pallas_attention_program(q_shape, kv_shape, causal: bool, scale: float, jdtype: str):
+    """Jitted Mosaic (Pallas) flash-attention program for one signature, or
+    None if the kernel cannot compile for it (VMEM overflow etc.) — the
+    failure is cached so the signature is probed exactly once, and other
+    signatures keep the kernel. AOT-compiled here so a per-shape Mosaic
+    error can never surface at dispatch time."""
+    global _PALLAS_ATTENTION_UNAVAILABLE
+    if _PALLAS_ATTENTION_UNAVAILABLE:
+        return None
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            BlockSizes,
+            flash_attention,
+        )
+    except Exception:
+        _PALLAS_ATTENTION_UNAVAILABLE = True
+        return None
+
+    sq, skv = q_shape[-2], kv_shape[-2]
+    # v5e-tuned tiles (interleaved sweep: ~1.4x over the blocked XLA
+    # program at S=4096); clamp to divisors of the sequence length
+    bq = 1024 if sq % 1024 == 0 else 512
+    bkm = 2048 if skv % 2048 == 0 else (1024 if skv % 1024 == 0 else 512)
+    bk = 1024 if skv % 2048 == 0 else 512
+    bs = BlockSizes(
+        block_q=bq, block_k_major=bkm, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bkm, block_k_dkv=bk, block_q_dkv=bq,
+        block_k_major_dq=bkm, block_k_dq=bk, block_q_dq=bq,
+    )
+
+    def run(qa, ka, va):
+        # the kernel's block-index maps mix int32 iotas with Python ints;
+        # tracing them in the framework's global x64 mode produces
+        # int64/int32 lax.select mismatches — trace with x64 off (the
+        # f32/bf16 operands are unaffected; same scoped workaround as
+        # linalg._lapack)
+        with jax.enable_x64(False):
+            return flash_attention(
+                qa, ka, va, causal=causal, sm_scale=float(scale), block_sizes=bs
+            )
+
+    prog = jax.jit(run)
+    try:
+        jt = jnp.dtype(jdtype)
+        prog.lower(
+            jax.ShapeDtypeStruct(q_shape, jt),
+            jax.ShapeDtypeStruct(kv_shape, jt),
+            jax.ShapeDtypeStruct(kv_shape, jt),
+        ).compile()
+    except Exception:
+        return None
+    return prog
+
+
+def _pallas_attention(qa, ka, va, causal: bool, scale: float):
+    """Mosaic (Pallas) fused flash-attention kernel for the single-device
+    path — the native-kernel realization of the same online-softmax
+    algorithm (one (Bq, Bk) tile in VMEM at a time). Returns None when the
+    workload does not fit the kernel's tiling constraints; the blocked
+    XLA program is the fallback and the numerical oracle."""
+    if jax.default_backend() != "tpu":
+        return None
+    if qa.ndim != 4 or qa.dtype not in (jnp.float32, jnp.bfloat16):
+        return None
+    b, h, sq, d = qa.shape
+    skv = ka.shape[-2]
+    # kernel tiling: seq axes in 128-row blocks, head_dim lane-aligned,
+    # q and kv heads/batch equal, self-attention lengths only
+    if (
+        ka.shape != (b, h, skv, d)
+        or va.shape != (b, h, skv, d)
+        or sq != skv
+        or sq % 512
+        or d % 64
+    ):
+        return None
+    prog = _pallas_attention_program(
+        tuple(qa.shape), tuple(ka.shape), bool(causal), float(scale),
+        np.dtype(qa.dtype).name,
+    )
+    if prog is None:
+        return None
+    return prog(qa, ka, va)
+
+
 def _single_device_attention(qa, ka, va, causal: bool, scale):
     """Shared single-device flash attention on raw jax arrays: non-inexact
     dtypes promote to float32, the default scale is 1/sqrt(d), and the
@@ -173,6 +264,9 @@ def _single_device_attention(qa, ka, va, causal: bool, scale):
     qa, ka, va = (t.astype(jt) for t in (qa, ka, va))
     if scale is None:
         scale = 1.0 / float(np.sqrt(qa.shape[-1]))
+    out = _pallas_attention(qa, ka, va, bool(causal), float(scale))
+    if out is not None:
+        return out
     prog = _blocked_attention_program(
         tuple(qa.shape), tuple(ka.shape), tuple(va.shape),
         bool(causal), float(scale), np.dtype(jt).name,

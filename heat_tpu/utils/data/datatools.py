@@ -37,11 +37,12 @@ __all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_permute(comm, ndim: int, jdtype: str, split):
+def _cached_permute(comm, ndim: int, split):
     """Jitted global permutation along axis 0, sharding preserved — the
     collective replacement for the reference's Isend/Irecv half-ring +
     local randperm (datatools.py:246-343). ``x`` is committed, so
-    ``jit_sharded``'s one-device fast path applies."""
+    ``jit_sharded``'s one-device fast path applies; jit retraces per
+    operand dtype/shape on its own."""
 
     def permute(x, perm):
         return jnp.take(x, perm, axis=0)
@@ -55,12 +56,7 @@ def _global_shuffle(array: DNDarray, perm: jax.Array) -> DNDarray:
     PHYSICAL extent with pad rows fixed in place, keeping the zero-pad
     invariant."""
     phys = array._phys
-    permute = _cached_permute(
-        array.comm,
-        phys.ndim,
-        np.dtype(phys.dtype).name,
-        array.split,
-    )
+    permute = _cached_permute(array.comm, phys.ndim, array.split)
     out = permute(phys, perm)
     return DNDarray(out, array.shape, array.dtype, array.split, array.device, array.comm)
 
