@@ -29,7 +29,11 @@ BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_
 # workload sizes (single chip; reference cb sizes where they fit)
 N_MATMUL = 3000          # benchmarks/cb/linalg.py:45
 N_QR = 2000              # benchmarks/cb/linalg.py:55
-HSVD_M, HSVD_N, HSVD_R = 16384, 2048, 10   # tall-skinny split-0 north star
+HSVD_M, HSVD_N, HSVD_R = 16384, 2048, 10   # torch-comparable baseline workload
+HSVD_BIG_M, HSVD_BIG_N = 65536, 8192       # 2.1 GB — the north-star per-chip shard
+                                           # (200 GB over v5e-64 ~ 3 GB/chip); no
+                                           # torch baseline: a full CPU SVD at this
+                                           # size is O(days)
 KM_N, KM_D, KM_K = 1_048_576, 64, 8        # KMeans iter/s at scale
 RESHAPE_SHAPE = (1000, 250_000)            # cb uses 1000x10M..40M on a cluster
 CONCAT_SIZES = (10_000, 20_000, 40_000)    # benchmarks/cb/manipulations.py:20
@@ -188,6 +192,11 @@ def measure_heat_tpu() -> dict:
     out["hsvd"] = amortized(lambda: ht.linalg.hsvd_rank(d, HSVD_R)[0], reps=8, inner=16)
     del d
 
+    # headline: the same op at the north-star per-chip shard size
+    dbig = ht.random.randn(HSVD_BIG_M, HSVD_BIG_N, split=0)
+    out["hsvd_2gb"] = amortized(lambda: ht.linalg.hsvd_rank(dbig, HSVD_R)[0], reps=6, inner=4)
+    del dbig
+
     from heat_tpu.cluster.kmeans import _lloyd_step
     x = ht.random.randn(KM_N, KM_D, split=0)
     cent = x.larray[:KM_K]
@@ -277,6 +286,7 @@ def main() -> None:
     hsvd_bytes = HSVD_M * HSVD_N * 4
     hsvd_gbps = hsvd_bytes / ours["hsvd"] / 1e9
     hsvd_base_gbps = hsvd_bytes / base["hsvd"] / 1e9 if base.get("hsvd") else None
+    hsvd_big_gbps = HSVD_BIG_M * HSVD_BIG_N * 4 / ours["hsvd_2gb"] / 1e9
 
     detail = {}
     for k, t_ours in ours.items():
@@ -308,10 +318,15 @@ def main() -> None:
         detail["ring_attention"]["tflops"] = round(flops / ours["ring_attention"] / 1e12, 2)
     detail["sum"]["gbps"] = round(SUM_N * 4 / ours["sum"] / 1e9, 2)
     detail["hsvd"]["gbps"] = round(hsvd_gbps, 2)
+    detail["hsvd_2gb"]["gbps"] = round(hsvd_big_gbps, 2)
 
     result = {
-        "metric": f"hsvd_rank(r={HSVD_R}) GB/s/chip on {HSVD_M}x{HSVD_N} f32 split=0",
-        "value": round(hsvd_gbps, 3),
+        "metric": (
+            f"hsvd_rank(r={HSVD_R}) GB/s/chip on {HSVD_BIG_M}x{HSVD_BIG_N} f32 split=0 "
+            f"(2.1 GB, the north-star per-chip shard; vs_baseline from the "
+            f"{HSVD_M}x{HSVD_N} torch-comparable workload)"
+        ),
+        "value": round(hsvd_big_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(hsvd_gbps / hsvd_base_gbps, 3) if hsvd_base_gbps else None,
         "baseline": "reference engine (torch-CPU single-process Heat path), BENCH_BASELINE.json",

@@ -169,11 +169,12 @@ _PALLAS_ATTENTION_UNAVAILABLE = False
 
 @functools.lru_cache(maxsize=64)
 def _pallas_attention_program(q_shape, kv_shape, causal: bool, scale: float, jdtype: str):
-    """Jitted Mosaic (Pallas) flash-attention program for one signature, or
-    None if the kernel cannot compile for it (VMEM overflow etc.) — the
-    failure is cached so the signature is probed exactly once, and other
-    signatures keep the kernel. AOT-compiled here so a per-shape Mosaic
-    error can never surface at dispatch time."""
+    """AOT-compiled Mosaic (Pallas) flash-attention executable for one
+    signature, or None if the kernel cannot compile for it (VMEM overflow
+    etc.) — the failure is cached so the signature is probed exactly once,
+    and other signatures keep the kernel. Compiling here means a per-shape
+    Mosaic error can never surface at dispatch time (dispatch only happens
+    on concrete arrays; traced calls are gated to the blocked program)."""
     global _PALLAS_ATTENTION_UNAVAILABLE
     if _PALLAS_ATTENTION_UNAVAILABLE:
         return None
@@ -209,17 +210,18 @@ def _pallas_attention_program(q_shape, kv_shape, causal: bool, scale: float, jdt
                 qa, ka, va, causal=causal, sm_scale=float(scale), block_sizes=bs
             )
 
-    prog = jax.jit(run)
     try:
         jt = jnp.dtype(jdtype)
-        prog.lower(
+        # the AOT Compiled executable is what gets called — compiling once
+        # and dispatching through jit would compile the kernel a second
+        # time (AOT lowering does not populate jit's dispatch cache)
+        return jax.jit(run).lower(
             jax.ShapeDtypeStruct(q_shape, jt),
             jax.ShapeDtypeStruct(kv_shape, jt),
             jax.ShapeDtypeStruct(kv_shape, jt),
         ).compile()
     except Exception:
         return None
-    return prog
 
 
 def _pallas_attention(qa, ka, va, causal: bool, scale: float):
@@ -229,6 +231,13 @@ def _pallas_attention(qa, ka, va, causal: bool, scale: float):
     workload does not fit the kernel's tiling constraints; the blocked
     XLA program is the fallback and the numerical oracle."""
     if jax.default_backend() != "tpu":
+        return None
+    if any(isinstance(t, jax.core.Tracer) for t in (qa, ka, va)):
+        # inside a user jit/grad trace: only the blocked program is
+        # guaranteed differentiable and compilable — the flash kernel's
+        # custom-vjp backward would be traced under the framework's global
+        # x64 mode (which its block-index maps cannot handle) and its
+        # dkv/dq kernels are never AOT-probed
         return None
     if qa.ndim != 4 or qa.dtype not in (jnp.float32, jnp.bfloat16):
         return None

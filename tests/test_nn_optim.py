@@ -311,6 +311,30 @@ class TestRingAttention:
         np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-5)
 
 
+class TestPallasAttentionGating:
+    """The Mosaic flash kernel is a TPU-only fast path: on any other
+    backend the gate must return None (blocked program serves), and a
+    per-signature compile failure must not disable other signatures."""
+
+    def test_gate_off_on_cpu(self):
+        import jax.numpy as jnp
+        from heat_tpu.nn import attention as att
+
+        x = jnp.zeros((1, 1, 512, 64), jnp.float32)
+        assert att._pallas_attention(x, x, x, False, 0.125) is None
+        # gating must not have flipped the import-unavailable flag
+        assert not att._PALLAS_ATTENTION_UNAVAILABLE
+
+    def test_gate_rejects_unfit_shapes(self):
+        import jax.numpy as jnp
+        from heat_tpu.nn import attention as att
+
+        # 3-D input, odd seq, odd head dim: all rejected before any compile
+        for shape in [(8, 512, 64), (1, 1, 500, 64), (1, 1, 512, 60)]:
+            x = jnp.zeros(shape, jnp.float32)
+            assert att._pallas_attention(x, x, x, True, 0.125) is None
+
+
 class TestSDPAAlias:
     """torch-parity F.scaled_dot_product_attention over ring/blocked
     attention (reference functional is a torch passthrough)."""
