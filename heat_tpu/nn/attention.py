@@ -224,6 +224,23 @@ def _pallas_attention_program(q_shape, kv_shape, causal: bool, scale: float, jdt
         return None
 
 
+def _pallas_attention_fits(q_shape, k_shape, v_shape, dtype) -> bool:
+    """Backend-independent tiling gate for the flash kernel: 4-D f32/bf16
+    self-attention with 512-multiple sequence length and lane-aligned
+    (64-multiple) head dim, q/k/v agreeing on batch/head/seq dims."""
+    if len(q_shape) != 4 or jnp.dtype(dtype) not in (jnp.float32, jnp.bfloat16):
+        return False
+    b, h, sq, d = q_shape
+    skv = k_shape[-2]
+    return (
+        tuple(k_shape) == (b, h, skv, d)
+        and tuple(v_shape) == (b, h, skv, d)
+        and sq == skv
+        and sq % 512 == 0
+        and d % 64 == 0
+    )
+
+
 def _pallas_attention(qa, ka, va, causal: bool, scale: float):
     """Mosaic (Pallas) fused flash-attention kernel for the single-device
     path — the native-kernel realization of the same online-softmax
@@ -239,19 +256,16 @@ def _pallas_attention(qa, ka, va, causal: bool, scale: float):
         # x64 mode (which its block-index maps cannot handle) and its
         # dkv/dq kernels are never AOT-probed
         return None
-    if qa.ndim != 4 or qa.dtype not in (jnp.float32, jnp.bfloat16):
+    if not _pallas_attention_fits(qa.shape, ka.shape, va.shape, qa.dtype):
         return None
-    b, h, sq, d = qa.shape
-    skv = ka.shape[-2]
-    # kernel tiling: seq axes in 128-row blocks, head_dim lane-aligned,
-    # q and kv heads/batch equal, self-attention lengths only
-    if (
-        ka.shape != (b, h, skv, d)
-        or va.shape != (b, h, skv, d)
-        or sq != skv
-        or sq % 512
-        or d % 64
-    ):
+    # the Compiled executable is lowered for default-device placement;
+    # operands living elsewhere (explicit device_put, multi-chip sharding)
+    # take the jitted blocked program, which places freely
+    try:
+        devs = {d for t in (qa, ka, va) for d in t.devices()}
+    except Exception:
+        return None
+    if devs != {jax.devices()[0]}:
         return None
     prog = _pallas_attention_program(
         tuple(qa.shape), tuple(ka.shape), bool(causal), float(scale),
@@ -259,7 +273,11 @@ def _pallas_attention(qa, ka, va, causal: bool, scale: float):
     )
     if prog is None:
         return None
-    return prog(qa, ka, va)
+    try:
+        return prog(qa, ka, va)
+    except Exception:
+        # placement/runtime mismatch the gates missed — blocked fallback
+        return None
 
 
 def _single_device_attention(qa, ka, va, causal: bool, scale):

@@ -316,23 +316,33 @@ class TestPallasAttentionGating:
     backend the gate must return None (blocked program serves), and a
     per-signature compile failure must not disable other signatures."""
 
-    def test_gate_off_on_cpu(self):
+    def test_gate_off_on_non_tpu_backend(self):
+        import jax
         import jax.numpy as jnp
         from heat_tpu.nn import attention as att
 
+        if jax.default_backend() == "tpu":
+            pytest.skip("gate is open on a real TPU backend")
         x = jnp.zeros((1, 1, 512, 64), jnp.float32)
         assert att._pallas_attention(x, x, x, False, 0.125) is None
         # gating must not have flipped the import-unavailable flag
         assert not att._PALLAS_ATTENTION_UNAVAILABLE
 
-    def test_gate_rejects_unfit_shapes(self):
+    def test_shape_gate_backend_independent(self):
         import jax.numpy as jnp
-        from heat_tpu.nn import attention as att
+        from heat_tpu.nn.attention import _pallas_attention_fits
 
-        # 3-D input, odd seq, odd head dim: all rejected before any compile
-        for shape in [(8, 512, 64), (1, 1, 500, 64), (1, 1, 512, 60)]:
-            x = jnp.zeros(shape, jnp.float32)
-            assert att._pallas_attention(x, x, x, True, 0.125) is None
+        good = (1, 1, 512, 64)
+        assert _pallas_attention_fits(good, good, good, jnp.float32)
+        assert _pallas_attention_fits(good, good, good, jnp.bfloat16)
+        # 3-D input, odd seq, odd head dim, f64, cross-attention lengths,
+        # mismatched value head dim: all rejected before any compile
+        assert not _pallas_attention_fits((8, 512, 64), (8, 512, 64), (8, 512, 64), jnp.float32)
+        assert not _pallas_attention_fits((1, 1, 500, 64), (1, 1, 500, 64), (1, 1, 500, 64), jnp.float32)
+        assert not _pallas_attention_fits((1, 1, 512, 60), (1, 1, 512, 60), (1, 1, 512, 60), jnp.float32)
+        assert not _pallas_attention_fits(good, good, good, jnp.float64)
+        assert not _pallas_attention_fits(good, (1, 1, 1024, 64), (1, 1, 1024, 64), jnp.float32)
+        assert not _pallas_attention_fits(good, good, (1, 1, 512, 128), jnp.float32)
 
 
 class TestSDPAAlias:

@@ -334,3 +334,66 @@ class TestScalarReshape(TestCase):
         r = ht.array(np.array([5.0], np.float32), split=0).reshape(())
         assert float(r.numpy()) == 5.0
         assert r.split is None
+
+
+class TestBF16Numerics:
+    """bfloat16 end-to-end numerics on the mesh — the TPU-first dtype the
+    reference only passes through to torch. Tolerances follow bf16's ~3
+    decimal digits (8-bit mantissa)."""
+
+    def test_matmul_bf16_vs_f32_oracle(self):
+        rng = np.random.default_rng(7)
+        an = rng.standard_normal((33, 17)).astype(np.float32)
+        bn = rng.standard_normal((17, 21)).astype(np.float32)
+        a = ht.array(an, dtype=ht.bfloat16, split=0)
+        b = ht.array(bn, dtype=ht.bfloat16, split=0)
+        out = ht.matmul(a, b)
+        assert out.dtype is ht.bfloat16
+        ref = an @ bn
+        got = np.asarray(out.astype(ht.float32).numpy())
+        # bf16 inputs quantize once (~2^-8 relative) before the MXU f32 accumulate
+        np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
+
+    def test_reductions_keep_bf16_dtype(self):
+        # reference parity: torch.sum(bfloat16) stays bfloat16
+        x = ht.ones(1000, dtype=ht.bfloat16, split=0)
+        s = ht.sum(x)
+        assert s.dtype is ht.bfloat16
+        assert float(s) == 1000.0  # 1000 is exactly representable in bf16
+        m = ht.mean(ht.arange(8, dtype=ht.bfloat16, split=0))
+        assert abs(float(m) - 3.5) < 1e-2
+
+    def test_elementwise_chain_bf16(self):
+        rng = np.random.default_rng(8)
+        xn = rng.standard_normal(129).astype(np.float32)
+        x = ht.array(xn, dtype=ht.bfloat16, split=0)
+        y = ht.exp(ht.sin(x) * 0.5)
+        ref = np.exp(np.sin(xn.astype(jnp.bfloat16).astype(np.float32)) * 0.5)
+        np.testing.assert_allclose(
+            np.asarray(y.astype(ht.float32).numpy()), ref, rtol=2e-2, atol=2e-2
+        )
+
+    def test_ring_attention_bf16(self):
+        rng = np.random.default_rng(9)
+        S, D = 64, 8
+        qn = rng.standard_normal((S, D)).astype(np.float32)
+        q32 = ht.array(qn, split=0)
+        qbf = ht.array(qn, dtype=ht.bfloat16, split=0)
+        ref = np.asarray(ht.nn.ring_attention(q32, q32, q32, causal=True).numpy())
+        out = ht.nn.ring_attention(qbf, qbf, qbf, causal=True)
+        assert out.dtype is ht.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out.astype(ht.float32).numpy()), ref, rtol=5e-2, atol=5e-2
+        )
+
+    def test_bf16_io_roundtrip_via_f32(self, tmp_path):
+        # HDF5 has no bf16: saves upcast to f32, loads re-quantize
+        x = ht.array(np.linspace(-3, 3, 37, dtype=np.float32), dtype=ht.bfloat16, split=0)
+        p = str(tmp_path / "bf.h5")
+        ht.save_hdf5(x, p, "d")
+        back = ht.load_hdf5(p, "d", dtype=ht.bfloat16, split=0)
+        assert back.dtype is ht.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(back.astype(ht.float32).numpy()),
+            np.asarray(x.astype(ht.float32).numpy()),
+        )
