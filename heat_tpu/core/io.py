@@ -247,26 +247,49 @@ if __NETCDF:
         """
         if mode not in ("w", "a", "r+"):
             raise ValueError(f"mode must be one of 'w', 'a', 'r+', got {mode!r}")
-        arr = data.numpy()
+        if not isinstance(data, DNDarray):
+            raise TypeError(f"data must be a DNDarray, got {type(data)}")
+        np_dtype = (
+            np.float32 if data.dtype is types.bfloat16 else np.dtype(data.dtype.jax_type())
+        )
         if dimension_names is None:
-            dims = [f"{variable}_dim{i}" for i in range(arr.ndim)]
+            dims = [f"{variable}_dim{i}" for i in range(data.ndim)]
         elif isinstance(dimension_names, str):
             dims = [dimension_names]
         else:
             dims = list(dimension_names)
-        if len(dims) != arr.ndim:
+        if len(dims) != data.ndim:
             raise ValueError(
-                f"{len(dims)} dimension names given for {arr.ndim} dimensions"
+                f"{len(dims)} dimension names given for {data.ndim} dimensions"
             )
         with netCDF4.Dataset(path, mode) as handle:
             for i, name in enumerate(dims):
                 if name not in handle.dimensions:
-                    handle.createDimension(name, None if is_unlimited else arr.shape[i])
+                    handle.createDimension(name, None if is_unlimited else data.shape[i])
             if variable in handle.variables:
                 var = handle.variables[variable]
             else:
-                var = handle.createVariable(variable, arr.dtype, tuple(dims), **kwargs)
-            var[file_slices] = arr
+                var = handle.createVariable(variable, np_dtype, tuple(dims), **kwargs)
+            trivial = (
+                file_slices == slice(None)
+                or file_slices is Ellipsis
+                or (
+                    isinstance(file_slices, tuple)
+                    and all(s == slice(None) or s is Ellipsis for s in file_slices)
+                )
+            )
+            if trivial:
+                # one hyperslab write per device shard, never gathering
+                # (the reference's rank-ordered writes, io.py:366)
+                _write_shards(data, lambda sl, host: var.__setitem__(sl, host))
+            else:
+                # append-region addressing: the target region's geometry is
+                # the caller's (e.g. a new step along an unlimited dim) —
+                # write it in one piece
+                arr = data.numpy()
+                if data.dtype is types.bfloat16:
+                    arr = np.asarray(arr, dtype=np.float32)
+                var[file_slices] = arr
 
 
 def load_csv(
