@@ -775,6 +775,47 @@ class DNDarray:
             key = key.larray
         elif isinstance(key, tuple):
             key = tuple(k.larray if isinstance(k, DNDarray) else k for k in key)
+
+        # advanced-key fast paths on the PHYSICAL array: the pad lives at
+        # the global tail, so logical index i IS physical index i — an
+        # integer-array or bool-mask scatter that only names logical
+        # positions can run in place, skipping the unpad→set→reshard round
+        # trip of the general path
+        phys = self.__array
+        if (
+            isinstance(key, (jax.Array, np.ndarray))
+            and getattr(key, "dtype", None) is not None
+        ):
+            if key.dtype == jnp.bool_ and tuple(key.shape) == self.__gshape:
+                if phys.shape != tuple(self.__gshape):
+                    widths = [
+                        (0, p - g) for p, g in zip(phys.shape, self.__gshape)
+                    ]
+                    key = jnp.pad(jnp.asarray(key), widths)  # pad rows: False
+                self.__array = phys.at[key].set(value)
+                self._invalidate_caches()
+                return
+            if (
+                jnp.issubdtype(key.dtype, jnp.integer)
+                and self.ndim >= 1
+                and phys.shape[1:] == tuple(self.__gshape[1:])
+            ):
+                # non-indexed dims must be pad-free (split in {None, 0}) or
+                # the value's broadcast would span the pad region
+                n0 = self.__gshape[0]
+                k = jnp.asarray(key)
+                # out-of-range logical indices must NOT land in the pad
+                # region (physically in-bounds would corrupt the zero-pad
+                # invariant TSQR etc. rely on): remap anything outside
+                # [-n0, n0) past the PHYSICAL extent and drop it — the
+                # same silent-drop the logical at[] path had, without a
+                # host-side bounds check (a ~90 ms sync over the tunnel)
+                valid = (k >= -n0) & (k < n0)
+                k = jnp.where(valid, jnp.where(k < 0, k + n0, k), phys.shape[0])
+                self.__array = phys.at[k].set(value, mode="drop")
+                self._invalidate_caches()
+                return
+
         new = self.larray.at[key].set(value)
         self.__array = self.__comm.shard(new, self.__split)
         self._invalidate_caches()

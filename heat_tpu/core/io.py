@@ -61,6 +61,12 @@ def _from_numpy(data: np.ndarray, dtype, split, device, comm) -> DNDarray:
     return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
 
 
+def _np_storage_dtype(dtype) -> np.dtype:
+    """On-disk numpy dtype for a framework dtype: bfloat16 has no
+    HDF5/netCDF/CSV representation and is stored as float32 (exact)."""
+    return np.dtype(np.float32) if dtype is types.bfloat16 else np.dtype(dtype.jax_type())
+
+
 def _assemble_sharded(read_slab, gshape, dtype, split, device, comm) -> DNDarray:
     """Assemble a split DNDarray from per-device slab reads without ever
     materializing the global array on the host — the single-controller
@@ -78,7 +84,7 @@ def _assemble_sharded(read_slab, gshape, dtype, split, device, comm) -> DNDarray
     comm = sanitize_comm(comm)
     gshape = tuple(int(s) for s in gshape)
     split = sanitize_axis(gshape, split)
-    jdt = np.dtype(dtype.jax_type()) if dtype is not types.bfloat16 else np.float32
+    jdt = _np_storage_dtype(dtype)
 
     if split is None:
         # replicated: every host reads the full array once
@@ -199,10 +205,7 @@ if __HDF5:
             raise TypeError(f"data must be a DNDarray, got {type(data)}")
         if not isinstance(path, str):
             raise TypeError(f"path must be str, got {type(path)}")
-        np_dtype = (
-            np.float32 if data.dtype is types.bfloat16 else np.dtype(data.dtype.jax_type())
-        )
-        np_dtype = kwargs.pop("dtype", np_dtype)  # h5py casts on write
+        np_dtype = kwargs.pop("dtype", _np_storage_dtype(data.dtype))  # h5py casts on write
         with h5py.File(path, mode) as handle:
             ds = handle.create_dataset(dataset, shape=data.shape, dtype=np_dtype, **kwargs)
             _write_shards(data, lambda sl, host: ds.__setitem__(sl, host))
@@ -249,9 +252,7 @@ if __NETCDF:
             raise ValueError(f"mode must be one of 'w', 'a', 'r+', got {mode!r}")
         if not isinstance(data, DNDarray):
             raise TypeError(f"data must be a DNDarray, got {type(data)}")
-        np_dtype = (
-            np.float32 if data.dtype is types.bfloat16 else np.dtype(data.dtype.jax_type())
-        )
+        np_dtype = _np_storage_dtype(data.dtype)
         if dimension_names is None:
             dims = [f"{variable}_dim{i}" for i in range(data.ndim)]
         elif isinstance(dimension_names, str):
@@ -307,7 +308,7 @@ def load_csv(
     if not isinstance(path, str):
         raise TypeError(f"path must be str, got {type(path)}")
     dtype = types.canonical_heat_type(dtype)
-    np_dtype = np.dtype(dtype.jax_type()) if dtype is not types.bfloat16 else np.float32
+    np_dtype = _np_storage_dtype(dtype)
     data = np.genfromtxt(
         path, delimiter=sep, skip_header=header_lines, dtype=np_dtype, encoding=encoding
     )

@@ -120,6 +120,60 @@ class TestSmoke(TestCase):
             expected[0] = 0.0
             np.testing.assert_allclose(y.numpy(), expected)
 
+    def test_advanced_setitem_parity(self):
+        """Integer-array and bool-mask assignment across splits — the
+        fast paths scatter on the physical array (pad rows untouched),
+        the rest falls back; all must match numpy semantics, and the
+        zero-pad invariant must survive."""
+        data = np.arange(44, dtype=np.float32).reshape(11, 4)
+        idx = np.array([0, 3, 10, -1, 5])
+        for split in (None, 0, 1):
+            # integer-array scatter, scalar value
+            y = ht.array(data, split=split)
+            y[idx] = -1.0
+            expected = data.copy(); expected[idx] = -1.0
+            np.testing.assert_allclose(np.asarray(y.numpy()), expected)
+            # integer-array scatter, row values
+            y = ht.array(data, split=split)
+            rows = np.full((5, 4), 9.0, dtype=np.float32)
+            y[idx] = rows
+            expected = data.copy(); expected[idx] = rows
+            np.testing.assert_allclose(np.asarray(y.numpy()), expected)
+            # bool-mask scatter (DNDarray mask), scalar value
+            y = ht.array(data, split=split)
+            mask = y > 30.0
+            y[mask] = 0.0
+            expected = data.copy(); expected[expected > 30.0] = 0.0
+            np.testing.assert_allclose(np.asarray(y.numpy()), expected)
+            # pad rows must still be zero after the in-place scatters
+            import jax
+
+            phys = np.asarray(jax.device_get(y._phys))
+            if split is not None and phys.shape[split] > y.shape[split]:
+                tail = [slice(None)] * y.ndim
+                tail[split] = slice(y.shape[split], None)
+                assert np.all(phys[tuple(tail)] == 0)
+
+    def test_setitem_out_of_range_indices_dropped(self):
+        """Out-of-range integer indices are dropped (old advanced-path
+        behavior) and must NEVER land in the physical pad region — the
+        zero-pad invariant feeds pad-safe kernels like TSQR."""
+        import jax
+
+        data = np.arange(22, dtype=np.float32).reshape(11, 2)
+        y = ht.array(data, split=0)
+        y[np.array([11])] = 99.0   # past the end
+        y[np.array([-12])] = 55.0  # double-wrap hazard
+        np.testing.assert_allclose(np.asarray(y.numpy()), data)
+        phys = np.asarray(jax.device_get(y._phys))
+        if phys.shape[0] > 11:
+            assert np.all(phys[11:] == 0)
+        r = ht.linalg.qr(y).R
+        ref_r = np.linalg.qr(data)[1]
+        np.testing.assert_allclose(
+            np.abs(np.asarray(r.numpy())), np.abs(ref_r), rtol=1e-4
+        )
+
     def test_item_and_scalar_conversion(self):
         x = ht.array([[5.0]], split=0)
         self.assertEqual(x.item(), 5.0)
