@@ -803,7 +803,11 @@ class DNDarray:
                 # non-indexed dims must be pad-free (split in {None, 0}) or
                 # the value's broadcast would span the pad region
                 n0 = self.__gshape[0]
-                k = jnp.asarray(key)
+                # widen to signed: an unsigned key would promote -n0 into
+                # its own domain (valid all-False → silent drop) and a
+                # narrow int8/int16 key cannot hold the physical-extent
+                # sentinel
+                k = jnp.asarray(key).astype(jnp.int64)
                 # out-of-range logical indices must NOT land in the pad
                 # region (physically in-bounds would corrupt the zero-pad
                 # invariant TSQR etc. rely on): remap anything outside

@@ -164,7 +164,14 @@ class TestSmoke(TestCase):
         y = ht.array(data, split=0)
         y[np.array([11])] = 99.0   # past the end
         y[np.array([-12])] = 55.0  # double-wrap hazard
+        y[np.array([12], dtype=np.int8)] = 44.0   # narrow dtype sentinel overflow hazard
         np.testing.assert_allclose(np.asarray(y.numpy()), data)
+        # unsigned keys must ASSIGN (not silently drop): -n0 would promote
+        # into the unsigned domain without the signed widening
+        y2 = ht.array(data, split=0)
+        y2[np.array([1, 2], dtype=np.uint32)] = -5.0
+        expected = data.copy(); expected[[1, 2]] = -5.0
+        np.testing.assert_allclose(np.asarray(y2.numpy()), expected)
         phys = np.asarray(jax.device_get(y._phys))
         if phys.shape[0] > 11:
             assert np.all(phys[11:] == 0)
