@@ -46,9 +46,11 @@ def main() -> None:
         centers = np.sort(np.asarray(algo.cluster_centers_.numpy()).round(1), axis=0)
         print(f"{name:9s} n_iter={getattr(algo, 'n_iter_', '?'):>3} centers (sorted):")
         print(centers)
-        # the spherical generator plants clusters at +-4 along alternating axes;
-        # every recovered center must sit near one of them
+        # the spherical generator plants clusters at diag(-8), diag(-4),
+        # diag(4), diag(8); sorted recovered centers must sit near them
+        planted = np.array([[-8.0] * 3, [-4.0] * 3, [4.0] * 3, [8.0] * 3])
         assert centers.shape == (4, 3)
+        assert np.abs(centers - planted).max() < 1.0, centers
 
 
 if __name__ == "__main__":

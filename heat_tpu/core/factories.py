@@ -282,8 +282,12 @@ def empty_like(a: DNDarray, dtype=None, split=None, device=None, comm=None, orde
     return __factory_like(a, empty, dtype, split, device, comm)
 
 
-def eye(shape, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
-    """2-D array with ones on the diagonal (reference: factories.py:618)."""
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order: str = "C") -> DNDarray:
+    """2-D array with ones on the diagonal (reference: factories.py:618).
+    ``order`` is accepted for API parity; XLA owns physical layout (see
+    memory.sanitize_memory_layout)."""
+    if order not in ("C", "F"):
+        raise ValueError(f"order must be 'C' or 'F', got {order!r}")
     if isinstance(shape, (int, np.integer)):
         gshape = (int(shape), int(shape))
     else:

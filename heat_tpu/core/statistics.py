@@ -243,9 +243,12 @@ def histc(input: DNDarray, bins: int = 100, min: float = 0.0, max: float = 0.0, 
     )
 
 
-def histogram(a: DNDarray, bins: int = 10, range=None, weights=None, density=None):
+def histogram(a: DNDarray, bins: int = 10, range=None, normed=None, weights=None, density=None):
     """NumPy-style histogram; returns (hist, bin_edges) (reference:
-    statistics.py histogram)."""
+    statistics.py histogram — ``normed`` rejected the same way,
+    statistics.py:716)."""
+    if normed is not None:
+        raise NotImplementedError("'normed' is not supported")
     sanitize_in(a)
     arr = a.larray
     w = weights.larray if isinstance(weights, DNDarray) else weights
