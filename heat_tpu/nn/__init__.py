@@ -18,6 +18,10 @@ from .modules import (
     Softmax,
     Flatten,
     Dropout,
+    Dropout2d,
+    Conv2d,
+    MaxPool2d,
+    AvgPool2d,
     Sequential,
     MSELoss,
     NLLLoss,
@@ -38,6 +42,10 @@ __all__ = [
     "Softmax",
     "Flatten",
     "Dropout",
+    "Dropout2d",
+    "Conv2d",
+    "MaxPool2d",
+    "AvgPool2d",
     "Sequential",
     "MSELoss",
     "NLLLoss",

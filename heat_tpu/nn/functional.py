@@ -52,6 +52,28 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, is_causal=Fa
     return _single_device_attention(query, key, value, bool(is_causal), scale)
 
 
+def max_pool2d(x, kernel_size, stride=None):
+    """torch.nn.functional.max_pool2d parity over NCHW (the reference CNN
+    example calls F.max_pool2d, examples/nn/mnist.py)."""
+    from .modules import MaxPool2d
+
+    return MaxPool2d(kernel_size, stride).apply({}, x)
+
+
+def avg_pool2d(x, kernel_size, stride=None):
+    """torch.nn.functional.avg_pool2d parity over NCHW."""
+    from .modules import AvgPool2d
+
+    return AvgPool2d(kernel_size, stride).apply({}, x)
+
+
+def dropout(x, p=0.5, training=True, key=None):
+    """torch.nn.functional.dropout parity (explicit PRNG key)."""
+    from .modules import Dropout
+
+    return Dropout(p).apply({}, x, train=training, key=key)
+
+
 def linear(x, weight, bias=None):
     """y = x W (+ b) with weight stored (in, out) — see nn.Linear."""
     y = x @ weight

@@ -29,6 +29,9 @@ from typing import Any, Optional, Sequence, Tuple
 __all__ = [
     "Module",
     "Linear",
+    "Conv2d",
+    "MaxPool2d",
+    "AvgPool2d",
     "ReLU",
     "GELU",
     "Tanh",
@@ -37,11 +40,19 @@ __all__ = [
     "Softmax",
     "Flatten",
     "Dropout",
+    "Dropout2d",
     "Sequential",
     "MSELoss",
     "NLLLoss",
     "CrossEntropyLoss",
 ]
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        a, b = v
+        return int(a), int(b)
+    return int(v), int(v)
 
 
 class Module:
@@ -94,6 +105,114 @@ class Linear(Module):
         if self.bias:
             y = y + params["bias"]
         return y
+
+
+class Conv2d(Module):
+    """2-D convolution over NCHW inputs — torch.nn.Conv2d parity (the
+    reference's CNN example, examples/nn/mnist.py:26, uses ht.nn.Conv2d
+    via the torch passthrough) including its Kaiming-uniform init. The
+    contraction lowers to ``lax.conv_general_dilated``, which XLA tiles
+    onto the MXU.
+    """
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, bias: bool = True, dtype=jnp.float32):
+        self.in_channels = int(in_channels)
+        self.out_channels = int(out_channels)
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        if isinstance(padding, str):
+            pad = padding.lower()
+            if pad == "valid":
+                self.padding = ((0, 0), (0, 0))
+            elif pad == "same":
+                if self.stride != (1, 1):
+                    # torch parity: conv.py raises the same way
+                    raise ValueError(
+                        "padding='same' is not supported for strided convolutions"
+                    )
+                # torch puts the odd element of an even kernel's padding on
+                # the HIGH side of each dim; XLA's "SAME" string does not,
+                # so spell the pads out
+                kh, kw = self.kernel_size
+                self.padding = (
+                    ((kh - 1) // 2, kh - 1 - (kh - 1) // 2),
+                    ((kw - 1) // 2, kw - 1 - (kw - 1) // 2),
+                )
+            else:
+                raise ValueError(f"padding must be 'same', 'valid' or ints, got {padding!r}")
+        else:
+            ph, pw = _pair(padding)
+            self.padding = ((ph, ph), (pw, pw))
+        self.bias = bool(bias)
+        self.dtype = dtype
+
+    def init(self, key: jax.Array):
+        kh, kw = self.kernel_size
+        fan_in = self.in_channels * kh * kw
+        bound = 1.0 / math.sqrt(fan_in)
+        wkey, bkey = jax.random.split(key)
+        params = {
+            "weight": jax.random.uniform(
+                wkey, (self.out_channels, self.in_channels, kh, kw),
+                minval=-bound, maxval=bound, dtype=self.dtype,
+            )
+        }
+        if self.bias:
+            params["bias"] = jax.random.uniform(
+                bkey, (self.out_channels,), minval=-bound, maxval=bound, dtype=self.dtype
+            )
+        return params
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        y = jax.lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=self.stride,
+            padding=self.padding,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        if self.bias:
+            y = y + params["bias"][None, :, None, None]
+        return y
+
+
+class _Pool2d(Module):
+    def __init__(self, kernel_size, stride=None):
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride) if stride is not None else self.kernel_size
+
+    def _window(self, x):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        return (1, 1, kh, kw), (1, 1, sh, sw)
+
+
+class MaxPool2d(_Pool2d):
+    """torch.nn.MaxPool2d parity over NCHW (lax.reduce_window max)."""
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        dims, strides = self._window(x)
+        # init must be a CONCRETE scalar of the operand dtype: a Python int
+        # mismatches narrow int dtypes and a traced jnp constant breaks
+        # reduce_window's reverse-mode rule
+        import numpy as _np
+
+        neg = (
+            -jnp.inf if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.iinfo(x.dtype).min
+        )
+        return jax.lax.reduce_window(
+            x, _np.dtype(x.dtype).type(neg), jax.lax.max, dims, strides, "VALID"
+        )
+
+
+class AvgPool2d(_Pool2d):
+    """torch.nn.AvgPool2d parity over NCHW (lax.reduce_window mean)."""
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        dims, strides = self._window(x)
+        kh, kw = self.kernel_size
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, "VALID")
+        return summed / (kh * kw)
 
 
 class _Activation(Module):
@@ -150,14 +269,25 @@ class Dropout(Module):
             raise ValueError(f"dropout probability must be in [0, 1), got {p}")
         self.p = float(p)
 
+    def _mask_shape(self, x):
+        return x.shape
+
     def apply(self, params, x, *, train: bool = False, key=None):
         if not train or self.p == 0.0:
             return x
         if key is None:
-            raise ValueError("Dropout.apply(train=True) requires a PRNG key")
+            raise ValueError(f"{type(self).__name__}.apply(train=True) requires a PRNG key")
         keep = 1.0 - self.p
-        mask = jax.random.bernoulli(key, keep, x.shape)
+        mask = jax.random.bernoulli(key, keep, self._mask_shape(x))
         return jnp.where(mask, x / keep, 0.0)
+
+
+class Dropout2d(Dropout):
+    """Channel-wise dropout over NCHW (torch.nn.Dropout2d parity): whole
+    feature maps are zeroed together."""
+
+    def _mask_shape(self, x):
+        return x.shape[:2] + (1,) * (x.ndim - 2)
 
 
 class Sequential(Module):

@@ -370,3 +370,120 @@ class TestSDPAAlias:
         np.testing.assert_allclose(np.asarray(out2), ref, rtol=2e-4, atol=2e-5)
         with pytest.raises(NotImplementedError):
             F.scaled_dot_product_attention(jnp.asarray(qn), jnp.asarray(kn), jnp.asarray(vn), attn_mask=1)
+
+
+class TestConvLayers:
+    """CNN layer parity vs torch-CPU oracles — the reference's flagship
+    example is a Conv2d/Dropout2d/max_pool2d net (examples/nn/mnist.py:26)
+    served there by the torch passthrough."""
+
+    def _torch(self):
+        torch = pytest.importorskip("torch")
+        return torch
+
+    def test_conv2d_matches_torch(self):
+        torch = self._torch()
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 12, 12)).astype(np.float32)
+        for stride, padding in [(1, 0), (2, 1), (1, (2, 1))]:
+            m = htnn.Conv2d(3, 5, 3, stride=stride, padding=padding)
+            params = m.init(jax.random.PRNGKey(0))
+            tconv = torch.nn.Conv2d(3, 5, 3, stride=stride, padding=padding)
+            with torch.no_grad():
+                tconv.weight.copy_(torch.from_numpy(np.asarray(params["weight"])))
+                tconv.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+                ref = tconv(torch.from_numpy(x)).numpy()
+            got = np.asarray(m.apply(params, jnp.asarray(x)))
+            np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+    def test_conv2d_same_padding_matches_torch(self):
+        torch = self._torch()
+        import torch.nn.functional as tF
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((1, 1, 4, 4)).astype(np.float32)
+        # even kernels: torch pads the odd element on the HIGH side
+        for k in [2, 3, (2, 3)]:
+            w_shape = (1, 1) + (k if isinstance(k, tuple) else (k, k))
+            w = rng.standard_normal(w_shape).astype(np.float32)
+            ref = tF.conv2d(torch.from_numpy(x), torch.from_numpy(w), padding="same").numpy()
+            m = htnn.Conv2d(1, 1, k, padding="same", bias=False)
+            got = np.asarray(m.apply({"weight": jnp.asarray(w)}, jnp.asarray(x)))
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        # torch parity: strided 'same' is rejected
+        with pytest.raises(ValueError):
+            htnn.Conv2d(1, 1, 3, stride=2, padding="same")
+
+    def test_maxpool_integer_dtype(self):
+        import jax.numpy as jnp
+
+        x = jnp.arange(16, dtype=jnp.int32).reshape(1, 1, 4, 4)
+        out = np.asarray(htnn.MaxPool2d(2).apply({}, x))
+        np.testing.assert_array_equal(out[0, 0], [[5, 7], [13, 15]])
+
+    def test_pools_match_torch(self):
+        torch = self._torch()
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 4, 10, 10)).astype(np.float32)
+        for k, s in [(2, None), (3, 2), ((2, 3), (1, 2))]:
+            got = np.asarray(htnn.MaxPool2d(k, s).apply({}, jnp.asarray(x)))
+            ref = torch.nn.functional.max_pool2d(
+                torch.from_numpy(x), k, stride=s
+            ).numpy()
+            np.testing.assert_allclose(got, ref)
+            got = np.asarray(htnn.AvgPool2d(k, s).apply({}, jnp.asarray(x)))
+            ref = torch.nn.functional.avg_pool2d(
+                torch.from_numpy(x), k, stride=s
+            ).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_dropout2d_channelwise(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.ones((4, 6, 5, 5), jnp.float32)
+        out = np.asarray(
+            htnn.Dropout2d(0.5).apply({}, x, train=True, key=jax.random.PRNGKey(3))
+        )
+        # each (sample, channel) map is either all-zero or all-scaled
+        per_map = out.reshape(4, 6, -1)
+        for m in per_map.reshape(24, -1):
+            assert np.all(m == 0.0) or np.all(m == 2.0)
+        # eval mode: identity
+        np.testing.assert_array_equal(
+            np.asarray(htnn.Dropout2d(0.5).apply({}, x, train=False)), np.asarray(x)
+        )
+
+    def test_cnn_trains_under_data_parallel(self):
+        """The reference CNN shape (conv-conv-pool-fc) must train through
+        DataParallel + DataParallelOptimizer on the mesh."""
+        import jax
+
+        rng = np.random.default_rng(4)
+        n = 64
+        y_np = rng.integers(0, 2, size=n).astype(np.int32)
+        # class-dependent mean patch makes the task learnable
+        x_np = (
+            rng.standard_normal((n, 1, 8, 8)) + y_np[:, None, None, None] * 2.0
+        ).astype(np.float32)
+        net = htnn.Sequential(
+            htnn.Conv2d(1, 4, 3),
+            htnn.ReLU(),
+            htnn.MaxPool2d(2),
+            htnn.Flatten(),
+            htnn.Linear(4 * 3 * 3, 2),
+        )
+        dp = htnn.DataParallel(net, key=5)
+        opt = htoptim.DataParallelOptimizer(htoptim.Adam(lr=0.01), dp)
+        x = ht.array(x_np, split=0)
+        y = ht.array(y_np, split=0)
+        losses = [float(opt.step(x, y)) for _ in range(30)]
+        assert losses[-1] < 0.5 * losses[0], losses[::10]
+        preds = np.argmax(np.asarray(dp(x).numpy()), axis=1)
+        assert (preds == y_np).mean() > 0.9
