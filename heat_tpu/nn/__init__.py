@@ -22,6 +22,8 @@ from .modules import (
     Conv2d,
     MaxPool2d,
     AvgPool2d,
+    LayerNorm,
+    Embedding,
     Sequential,
     MSELoss,
     NLLLoss,
@@ -46,6 +48,8 @@ __all__ = [
     "Conv2d",
     "MaxPool2d",
     "AvgPool2d",
+    "LayerNorm",
+    "Embedding",
     "Sequential",
     "MSELoss",
     "NLLLoss",

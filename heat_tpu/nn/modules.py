@@ -32,6 +32,8 @@ __all__ = [
     "Conv2d",
     "MaxPool2d",
     "AvgPool2d",
+    "LayerNorm",
+    "Embedding",
     "ReLU",
     "GELU",
     "Tanh",
@@ -215,6 +217,68 @@ class AvgPool2d(_Pool2d):
         return summed / (kh * kw)
 
 
+class LayerNorm(Module):
+    """torch.nn.LayerNorm parity: normalize over the trailing
+    ``normalized_shape`` dims with learnable scale/shift."""
+
+    def __init__(self, normalized_shape, eps: float = 1e-5, elementwise_affine: bool = True,
+                 dtype=jnp.float32):
+        if isinstance(normalized_shape, (int,)):
+            normalized_shape = (int(normalized_shape),)
+        self.normalized_shape = tuple(int(s) for s in normalized_shape)
+        self.eps = float(eps)
+        self.elementwise_affine = bool(elementwise_affine)
+        self.dtype = dtype
+
+    def init(self, key: jax.Array):
+        if not self.elementwise_affine:
+            return {}
+        return {
+            "weight": jnp.ones(self.normalized_shape, self.dtype),
+            "bias": jnp.zeros(self.normalized_shape, self.dtype),
+        }
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        axes = tuple(range(x.ndim - len(self.normalized_shape), x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
+        y = (x - mean) / jnp.sqrt(var + self.eps)
+        if self.elementwise_affine:
+            y = y * params["weight"] + params["bias"]
+        return y
+
+
+class Embedding(Module):
+    """torch.nn.Embedding parity: lookup table with N(0, 1) init."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, dtype=jnp.float32):
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.dtype = dtype
+
+    def init(self, key: jax.Array):
+        return {
+            "weight": jax.random.normal(
+                key, (self.num_embeddings, self.embedding_dim), dtype=self.dtype
+            )
+        }
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        if not isinstance(x, jax.core.Tracer):
+            # torch parity: out-of-range ids raise instead of JAX's silent
+            # gather clamp (a -1 sentinel or vocab off-by-one would return
+            # wrong rows and train on corrupt lookups); traced calls keep
+            # clamp semantics — no host check is possible under jit
+            xa = jnp.asarray(x)
+            bad = (xa < 0) | (xa >= self.num_embeddings)
+            if bool(jnp.any(bad)):
+                raise IndexError(
+                    f"index out of range in Embedding({self.num_embeddings}, "
+                    f"{self.embedding_dim})"
+                )
+        return params["weight"][x]
+
+
 class _Activation(Module):
     _fn = None
 
@@ -265,8 +329,9 @@ class Flatten(Module):
 
 class Dropout(Module):
     def __init__(self, p: float = 0.5):
-        if not 0.0 <= p < 1.0:
-            raise ValueError(f"dropout probability must be in [0, 1), got {p}")
+        if not 0.0 <= p <= 1.0:
+            # torch parity: p=1.0 is legal (output all zeros)
+            raise ValueError(f"dropout probability must be in [0, 1], got {p}")
         self.p = float(p)
 
     def _mask_shape(self, x):
@@ -275,6 +340,8 @@ class Dropout(Module):
     def apply(self, params, x, *, train: bool = False, key=None):
         if not train or self.p == 0.0:
             return x
+        if self.p == 1.0:
+            return jnp.zeros_like(x)
         if key is None:
             raise ValueError(f"{type(self).__name__}.apply(train=True) requires a PRNG key")
         keep = 1.0 - self.p

@@ -487,3 +487,73 @@ class TestConvLayers:
         assert losses[-1] < 0.5 * losses[0], losses[::10]
         preds = np.argmax(np.asarray(dp(x).numpy()), axis=1)
         assert (preds == y_np).mean() > 0.9
+
+
+class TestNormAndEmbedding:
+    def test_layernorm_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 5, 8)).astype(np.float32)
+        m = htnn.LayerNorm(8)
+        params = m.init(jax.random.PRNGKey(0))
+        tln = torch.nn.LayerNorm(8)
+        ref = tln(torch.from_numpy(x)).detach().numpy()
+        got = np.asarray(m.apply(params, jnp.asarray(x)))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        # multi-dim normalized_shape, no affine
+        m2 = htnn.LayerNorm((5, 8), elementwise_affine=False)
+        tln2 = torch.nn.LayerNorm((5, 8), elementwise_affine=False)
+        np.testing.assert_allclose(
+            np.asarray(m2.apply({}, jnp.asarray(x))),
+            tln2(torch.from_numpy(x)).detach().numpy(),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_embedding_lookup(self):
+        m = htnn.Embedding(10, 4)
+        params = m.init(jax.random.PRNGKey(1))
+        idx = jnp.asarray([0, 3, 9, 3])
+        out = np.asarray(m.apply(params, idx))
+        np.testing.assert_array_equal(out[1], out[3])
+        np.testing.assert_array_equal(out, np.asarray(params["weight"])[np.asarray(idx)])
+
+    def test_tiny_transformer_block_with_ring_attention(self):
+        """Embedding + LayerNorm + ring attention + Linear — the
+        long-context building blocks compose on the mesh."""
+        S, D = 64, 8
+        rng = np.random.default_rng(2)
+        tokens = rng.integers(0, 16, size=S).astype(np.int32)
+        emb = htnn.Embedding(16, D)
+        ln = htnn.LayerNorm(D)
+        proj = htnn.Linear(D, D)
+        key = jax.random.PRNGKey(3)
+        k1, k2, k3 = jax.random.split(key, 3)
+        pe, pl, pp = emb.init(k1), ln.init(k2), proj.init(k3)
+        h = ln.apply(pl, emb.apply(pe, jnp.asarray(tokens)))
+        hd = ht.array(np.asarray(h), split=0)
+        att = ht.nn.ring_attention(hd, hd, hd, causal=True)
+        out = proj.apply(pp, att.larray)
+        assert out.shape == (S, D)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestTorchParityEdges:
+    def test_embedding_raises_out_of_range(self):
+        m = htnn.Embedding(4, 2)
+        params = m.init(jax.random.PRNGKey(0))
+        with pytest.raises(IndexError):
+            m.apply(params, jnp.asarray([3, 7]))
+        with pytest.raises(IndexError):
+            m.apply(params, jnp.asarray([-1]))
+        # traced calls keep gather-clamp semantics (documented)
+        out = jax.jit(lambda i: m.apply(params, i))(jnp.asarray([0, 3]))
+        assert out.shape == (2, 2)
+
+    def test_dropout_p1_zeroes(self):
+        x = jnp.ones((3, 3), jnp.float32)
+        out = htnn.Dropout(1.0).apply({}, x, train=True, key=jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(out), 0.0)
+        # eval mode: identity even at p=1 (torch parity)
+        np.testing.assert_array_equal(
+            np.asarray(htnn.Dropout(1.0).apply({}, x, train=False)), np.asarray(x)
+        )
