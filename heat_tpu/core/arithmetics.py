@@ -132,12 +132,15 @@ def diff(a: DNDarray, n: int = 1, axis: int = -1) -> DNDarray:
         raise ValueError(f"order must be non-negative but was {n}")
     axis = sanitize_axis(a.shape, axis)
     result = jnp.diff(a.larray, n=n, axis=axis)
+    # gshape is the LOGICAL shape — record it before shard() pads the
+    # split extent, or the pad rows leak into the logical view
+    gshape = tuple(int(s) for s in result.shape)
     split = a.split
     if split is not None:
         result = a.comm.shard(result, split)
     return DNDarray(
         result,
-        tuple(int(s) for s in result.shape),
+        gshape,
         types.canonical_heat_type(result.dtype),
         split,
         a.device,

@@ -239,6 +239,13 @@ class LayerNorm(Module):
         }
 
     def apply(self, params, x, *, train: bool = False, key=None):
+        tail = tuple(x.shape[x.ndim - len(self.normalized_shape):])
+        if tail != self.normalized_shape:
+            # torch parity: mismatches raise instead of silently
+            # normalizing/broadcasting over the wrong extent
+            raise ValueError(
+                f"expected input with trailing shape {self.normalized_shape}, got {tail}"
+            )
         axes = tuple(range(x.ndim - len(self.normalized_shape), x.ndim))
         mean = jnp.mean(x, axis=axes, keepdims=True)
         var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)

@@ -509,6 +509,14 @@ class TestNormAndEmbedding:
             rtol=1e-5, atol=1e-6,
         )
 
+    def test_layernorm_shape_mismatch_raises(self):
+        m = htnn.LayerNorm(8)
+        params = m.init(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError):
+            m.apply(params, jnp.zeros((3, 5, 1), jnp.float32))
+        with pytest.raises(ValueError):
+            htnn.LayerNorm((5, 8), elementwise_affine=False).apply({}, jnp.zeros((3, 4, 8)))
+
     def test_embedding_lookup(self):
         m = htnn.Embedding(10, 4)
         params = m.init(jax.random.PRNGKey(1))

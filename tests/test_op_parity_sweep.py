@@ -164,3 +164,17 @@ def test_reduce_parity(name, oracle, kwargs, split, axis):
     np.testing.assert_allclose(
         np.asarray(got.numpy()), ref, rtol=3e-4, atol=3e-5, err_msg=f"{name} axis={axis}"
     )
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("n", [1, 2, 3])
+@pytest.mark.parametrize("axis", [0, 1, -1])
+def test_diff_parity(split, n, axis):
+    """diff across splits/orders/axes — the split path shards the result,
+    and the recorded gshape must be the LOGICAL diff shape (regression:
+    the padded physical extent leaked into .numpy())."""
+    a = np.arange(24, dtype=np.float32).reshape(4, 6) ** 1.5
+    got = ht.diff(ht.array(a, split=split), n=n, axis=axis)
+    ref = np.diff(a, n=n, axis=axis)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got.numpy()), ref, rtol=1e-5)
