@@ -245,57 +245,24 @@ class TestEstimatorEdges:
 
 
 class TestSparseEdges:
-    def _fixture(self):
+    """Only behaviors NOT already pinned in tests/test_sparse.py:
+    global nnz and matrix-RHS SpMM."""
+
+    def test_gnnz_and_matrix_rhs_spmm(self):
         import scipy.sparse as sp
 
         rng = np.random.default_rng(0)
         dense = ((rng.random((9, 7)) < 0.4) * rng.standard_normal((9, 7))).astype(np.float32)
-        return dense, sp.csr_matrix(dense)
-
-    def test_roundtrip_nnz_scalar_ops(self):
-        dense, csr = self._fixture()
-        m = ht.sparse.sparse_csr_matrix(csr, split=0)
-        np.testing.assert_allclose(np.asarray(m.todense().numpy()), dense, rtol=1e-6)
-        assert m.gnnz == csr.nnz
-        np.testing.assert_allclose(
-            np.asarray((m * 3.0).todense().numpy()), 3 * dense, rtol=1e-6
-        )
-
-    def test_elementwise_add_mul(self):
-        dense, csr = self._fixture()
-        m = ht.sparse.sparse_csr_matrix(csr, split=0)
-        np.testing.assert_allclose(
-            np.asarray((m + m).todense().numpy()), 2 * dense, rtol=1e-6
-        )
-        np.testing.assert_allclose(
-            np.asarray(ht.sparse.mul(m, m).todense().numpy()), dense * dense, rtol=1e-6
-        )
-
-    def test_to_sparse_and_spmm(self):
-        dense, csr = self._fixture()
-        np.testing.assert_allclose(
-            np.asarray(ht.sparse.to_sparse(ht.array(dense, split=0)).todense().numpy()),
-            dense, rtol=1e-6,
-        )
+        m = ht.sparse.sparse_csr_matrix(sp.csr_matrix(dense), split=0)
+        assert m.gnnz == sp.csr_matrix(dense).nnz
         x = np.random.default_rng(1).standard_normal((7, 3)).astype(np.float32)
-        got = (ht.sparse.sparse_csr_matrix(csr, split=0) @ ht.array(x)).numpy()
+        got = (m @ ht.array(x)).numpy()
         np.testing.assert_allclose(np.asarray(got), dense @ x, rtol=1e-4, atol=1e-4)
-
-    def test_astype(self):
-        _, csr = self._fixture()
-        assert ht.sparse.sparse_csr_matrix(csr, split=0).astype(ht.float64).dtype is ht.float64
 
 
 class TestSignalEdges:
-    @pytest.mark.parametrize("mode", ["full", "same", "valid"])
-    def test_convolve_modes_split(self, mode):
-        rng = np.random.default_rng(2)
-        sig = rng.standard_normal(37).astype(np.float32)
-        ker = rng.standard_normal(5).astype(np.float32)
-        got = ht.convolve(ht.array(sig, split=0), ht.array(ker), mode=mode)
-        np.testing.assert_allclose(
-            np.asarray(got.numpy()), np.convolve(sig, ker, mode=mode), rtol=1e-4, atol=1e-5
-        )
+    """Only the operand-swap path (kernel longer than signal) — the mode
+    sweep lives in tests/test_parallel_primitives.py."""
 
     def test_convolve_kernel_longer_than_signal(self):
         rng = np.random.default_rng(3)
