@@ -127,7 +127,9 @@ def inv(a: DNDarray) -> DNDarray:
     return _wrap(result, a.split, a)
 
 
-def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
+def matmul(
+    a: DNDarray, b: DNDarray, allow_resplit: bool = False, precision=None
+) -> DNDarray:
     """Matrix product of two DNDarrays (reference: basics.py:421).
 
     Reference schedule: case analysis over (a.split, b.split) with a
@@ -149,7 +151,11 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
     arr_a = a.larray.astype(promoted.jax_type())
     arr_b = b.larray.astype(promoted.jax_type())
 
-    result = jnp.matmul(arr_a, arr_b)
+    # precision: None = chip default (bf16 MXU passes for f32, the same
+    # trade torch-CUDA's tf32 default makes); "highest" forces f32-exact
+    # accumulation at ~3x the MXU passes. jax.default_matmul_precision
+    # also applies as ambient context.
+    result = jnp.matmul(arr_a, arr_b, precision=precision)
 
     # output split per reference rules, generalized to batched dims
     out_ndim = result.ndim

@@ -245,19 +245,15 @@ class TestEstimatorEdges:
 
 
 class TestSparseEdges:
-    """Only behaviors NOT already pinned in tests/test_sparse.py:
-    global nnz and matrix-RHS SpMM."""
+    """Only behavior NOT already pinned in tests/test_sparse.py: gnnz."""
 
-    def test_gnnz_and_matrix_rhs_spmm(self):
+    def test_gnnz(self):
         import scipy.sparse as sp
 
         rng = np.random.default_rng(0)
         dense = ((rng.random((9, 7)) < 0.4) * rng.standard_normal((9, 7))).astype(np.float32)
         m = ht.sparse.sparse_csr_matrix(sp.csr_matrix(dense), split=0)
         assert m.gnnz == sp.csr_matrix(dense).nnz
-        x = np.random.default_rng(1).standard_normal((7, 3)).astype(np.float32)
-        got = (m @ ht.array(x)).numpy()
-        np.testing.assert_allclose(np.asarray(got), dense @ x, rtol=1e-4, atol=1e-4)
 
 
 class TestSignalEdges:
@@ -272,3 +268,20 @@ class TestSignalEdges:
         np.testing.assert_allclose(
             np.asarray(got.numpy()), np.convolve(ker, sig, mode="full"), rtol=1e-4, atol=1e-5
         )
+
+
+class TestMatmulPrecision:
+    def test_precision_kwarg_and_ambient_context(self):
+        """f32 matmul on TPU runs bf16 MXU passes by default (the same
+        trade as torch-CUDA's tf32); precision='highest' forces f32-exact
+        accumulation, and jax.default_matmul_precision applies as ambient
+        context. On CPU both paths are exact — this pins the API."""
+        import jax
+
+        a = np.random.default_rng(5).standard_normal((16, 8)).astype(np.float32)
+        x, y = ht.array(a, split=0), ht.array(a.T, split=1)
+        got = ht.matmul(x, y, precision="highest")
+        np.testing.assert_allclose(np.asarray(got.numpy()), a @ a.T, rtol=1e-5, atol=1e-5)
+        with jax.default_matmul_precision("highest"):
+            got2 = ht.matmul(x, y)
+        np.testing.assert_allclose(np.asarray(got2.numpy()), a @ a.T, rtol=1e-5, atol=1e-5)
