@@ -37,3 +37,13 @@ from . import spatial
 from . import utils
 from . import datasets
 from .version import __version__
+
+
+def __getattr__(name):
+    """Lazy ``tpu``/``gpu`` device singletons: platform probing is deferred
+    past import so ``init_distributed`` can run first (see core.devices)."""
+    if name in ("tpu", "gpu"):
+        from .core import devices as _devices
+
+        return getattr(_devices, name)
+    raise AttributeError(f"module 'heat_tpu' has no attribute {name!r}")

@@ -36,3 +36,12 @@ from . import linalg
 from .linalg import *
 
 from ..version import __version__
+
+
+def __getattr__(name):
+    """Lazy ``tpu``/``gpu`` device singletons (see devices module)."""
+    if name in ("tpu", "gpu"):
+        from . import devices as _devices
+
+        return getattr(_devices, name)
+    raise AttributeError(f"module 'heat_tpu.core' has no attribute {name!r}")

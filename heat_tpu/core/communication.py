@@ -94,14 +94,38 @@ class MeshCommunication(Communication):
     this model.
     """
 
-    __slots__ = ("_devices", "mesh", "axis_name", "_self_like")
+    __slots__ = ("_devices_", "_mesh", "axis_name", "_self_like")
 
     def __init__(self, devices=None, axis_name: str = "d"):
-        if devices is None:
-            devices = _platform_devices(None)
-        self._devices = list(devices)
+        # device resolution is LAZY when no explicit devices are given:
+        # probing the platform initializes the XLA backend, which must not
+        # happen at import time (the world singletons are built then) or
+        # jax.distributed.initialize can never run afterwards
         self.axis_name = axis_name
-        self.mesh = Mesh(np.array(self._devices), (axis_name,))
+        if devices is None:
+            self._devices_ = None
+            self._mesh = None
+        else:
+            self._devices_ = list(devices)
+            self._mesh = Mesh(np.array(self._devices_), (axis_name,))
+
+    def _resolve_devices(self) -> list:
+        return _platform_devices(None)
+
+    def _ensure(self) -> None:
+        if self._devices_ is None:
+            self._devices_ = list(self._resolve_devices())
+            self._mesh = Mesh(np.array(self._devices_), (self.axis_name,))
+
+    @property
+    def _devices(self) -> list:
+        self._ensure()
+        return self._devices_
+
+    @property
+    def mesh(self) -> Mesh:
+        self._ensure()
+        return self._mesh
 
     @property
     def size(self) -> int:
@@ -283,7 +307,12 @@ class MeshCommunication(Communication):
         }
 
     def __repr__(self) -> str:
-        return f"MeshCommunication(size={self.size}, axis={self.axis_name!r}, platform={self._devices[0].platform if self._devices else '-'})"
+        # must NOT resolve devices: a debug print before init_distributed
+        # would otherwise initialize the backend and consume the one-shot
+        # lazy window
+        if self._devices_ is None:
+            return f"MeshCommunication(unresolved, axis={self.axis_name!r})"
+        return f"MeshCommunication(size={self.size}, axis={self.axis_name!r}, platform={self._devices_[0].platform if self._devices_ else '-'})"
 
 
 # reference-compatible alias: programs written against the reference name
@@ -341,13 +370,25 @@ def init_distributed(
         kwargs["process_id"] = process_id
     if local_device_ids is not None:
         kwargs["local_device_ids"] = local_device_ids
-    jax.distributed.initialize(**kwargs)
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "must be called before" in str(e):
+            raise RuntimeError(
+                "init_distributed must run before any array/device use: the "
+                "world and device registry are lazy precisely so that "
+                "`import heat_tpu as ht; ht.core.communication."
+                "init_distributed(...)` works as the FIRST call — something "
+                "touched the backend earlier in this process"
+            ) from e
+        raise
 
     # rebuild the world IN PLACE: star-imported copies of MPI_WORLD
     # (heat_tpu.MPI_WORLD, pre-init local references) must all observe the
     # new global device set — rebinding the module global would leave them
     # pointing at the stale single-host world
     MPI_WORLD.__init__()
+    MPI_SELF.__init__()
     # compiled programs built before init baked the old mesh into their
     # out_shardings / shard_map meshes — drop them
     _clear_mesh_caches()
@@ -361,8 +402,10 @@ class _SelfCommunication(MeshCommunication):
     """Single-device communicator — the analog of MPI_COMM_SELF."""
 
     def __init__(self):
-        devs = _platform_devices(None)
-        super().__init__(devs[:1])
+        super().__init__(None)  # lazy, like the world
+
+    def _resolve_devices(self) -> list:
+        return _platform_devices(None)[:1]
 
 
 def _build_world() -> MeshCommunication:

@@ -82,11 +82,21 @@ class Device:
 cpu = Device("cpu", 0, "cpu")
 """The standard CPU device spanning all host devices."""
 
-# populate accelerator devices if the platforms exist
+# populated lazily: probing platforms initializes the XLA backend, which
+# must not happen at import time or jax.distributed.initialize (multi-host
+# bootstrap, communication.init_distributed) can never run afterwards
 _registry = {"cpu": cpu}
+_detected = False
+__default_device: Optional[Device] = None
 
 
-def _detect_accelerators() -> None:
+def _ensure_detected() -> None:
+    """Probe accelerator platforms and pick the default device, once, on
+    first use (NOT at import — see note on ``_registry``)."""
+    global _detected, __default_device
+    if _detected:
+        return
+    _detected = True
     for platform in ("tpu", "gpu"):
         try:
             devs = jax.devices(platform)
@@ -94,41 +104,42 @@ def _detect_accelerators() -> None:
             continue
         if devs:
             _registry[platform] = Device(platform, 0, platform)
+    # axon exposes TPUs under a plugin platform name; register as 'tpu'
+    if "tpu" not in _registry:
+        try:
+            _default = jax.devices()
+            if _default and _default[0].platform not in ("cpu", "gpu"):
+                _registry["tpu"] = Device("tpu", 0, _default[0].platform)
+        except RuntimeError:
+            pass
+    if __default_device is None:
+        # default device follows the default JAX backend (TPU when present)
+        try:
+            _backend = jax.default_backend()
+        except RuntimeError:
+            _backend = "cpu"
+        if _backend == "cpu":
+            __default_device = cpu
+        elif _backend == "gpu":
+            __default_device = _registry.get("gpu", cpu)
+        else:
+            __default_device = _registry.get("tpu", _registry.get(_backend, cpu))
 
 
-_detect_accelerators()
-
-# axon exposes TPUs under a plugin platform name; register under 'tpu' alias
-if "tpu" not in _registry:
-    try:
-        _default = jax.devices()
-        if _default and _default[0].platform not in ("cpu", "gpu"):
-            _registry["tpu"] = Device("tpu", 0, _default[0].platform)
-    except RuntimeError:
-        pass
-
-if "tpu" in _registry:
-    tpu = _registry["tpu"]
-    __all__.append("tpu")
-if "gpu" in _registry:
-    gpu = _registry["gpu"]
-    __all__.append("gpu")
-
-# default device follows the default JAX backend (TPU when present)
-try:
-    _backend = jax.default_backend()
-except RuntimeError:
-    _backend = "cpu"
-if _backend == "cpu":
-    __default_device = cpu
-elif _backend == "gpu":
-    __default_device = _registry.get("gpu", cpu)
-else:
-    __default_device = _registry.get("tpu", _registry.get(_backend, cpu))
+def __getattr__(name: str):
+    """Lazy ``tpu``/``gpu`` singletons (module attributes only exist when
+    the platform does — reference-API parity — but probing is deferred)."""
+    if name in ("tpu", "gpu"):
+        _ensure_detected()
+        if name in _registry:
+            return _registry[name]
+        raise AttributeError(f"no {name} platform available")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def get_device() -> Device:
     """The currently globally set default device (reference: devices.py:137)."""
+    _ensure_detected()
     return __default_device
 
 
@@ -139,6 +150,7 @@ def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
     if isinstance(device, Device):
         return device
     if isinstance(device, str):
+        _ensure_detected()
         name = device.strip().lower()
         if ":" in name:
             name, _, idx = name.partition(":")

@@ -1,0 +1,69 @@
+"""Worker program for the multi-process tests (tests/test_multiprocess.py).
+
+Each process: 2 local CPU devices; ``init_distributed`` wires the world to
+2 processes x 2 devices = a 4-device mesh spanning both. The import
+deliberately happens BEFORE init_distributed — the lazy device registry /
+world singletons exist precisely so that ordering works.
+"""
+
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+nprocs = int(sys.argv[2])
+port = sys.argv[3]
+h5path = sys.argv[4]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import heat_tpu as ht
+
+ht.core.communication.init_distributed(
+    coordinator_address=f"localhost:{port}", num_processes=nprocs, process_id=proc_id
+)
+
+import numpy as np
+
+comm = ht.get_comm()
+assert comm.size == 2 * nprocs, comm.size
+assert jax.process_count() == nprocs
+
+ref = np.arange(13 * 3, dtype=np.float32).reshape(13, 3)
+
+# factories + reduction over the multi-host mesh
+x0 = ht.arange(13, split=0, dtype=ht.float32)
+assert float(ht.sum(x0)) == 78.0
+
+# per-host hyperslab HDF5 ingest (each process reads only addressable slabs)
+x = ht.load_hdf5(h5path, "d", dtype=ht.float32, split=0)
+np.testing.assert_allclose(np.asarray(x.numpy()), ref)  # cross-process allgather
+
+# elementwise chain + reduction
+y = ht.exp(ht.sin(x) * 0.5)
+np.testing.assert_allclose(np.asarray(y.numpy()), np.exp(np.sin(ref) * 0.5), rtol=1e-5)
+np.testing.assert_allclose(float(ht.sum(x)), ref.sum(), rtol=1e-5)
+
+# shard_map collectives across processes: gather-free distributed sort
+sv, si = ht.sort(ht.array(np.asarray(ref[:, 0].copy()), split=0))
+np.testing.assert_allclose(np.asarray(sv.numpy()), np.sort(ref[:, 0]))
+
+# sharded matmul spanning both hosts
+m = ht.matmul(x, ht.array(ref.T, split=1))
+np.testing.assert_allclose(np.asarray(m.numpy()), ref @ ref.T, rtol=1e-4, atol=1e-4)
+
+# data-parallel training step across hosts
+from heat_tpu import nn, optim
+
+dp = nn.DataParallel(nn.Sequential(nn.Linear(3, 8), nn.ReLU(), nn.Linear(8, 2)), key=0)
+opt = optim.DataParallelOptimizer(optim.SGD(lr=0.1), dp)
+yb = ht.array((ref[:, 0] > 6).astype(np.int32), split=0)
+l0 = float(opt.step(x, yb))
+l1 = float(opt.step(x, yb))
+assert np.isfinite(l0) and l1 < l0, (l0, l1)
+
+print(f"[p{proc_id}] MULTIHOST_OK", flush=True)
