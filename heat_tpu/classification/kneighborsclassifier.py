@@ -35,6 +35,26 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
         self.y = None
         self._classes = None
 
+    @staticmethod
+    def one_hot_encoding(x: DNDarray) -> DNDarray:
+        """One-hot-encode an integer label vector (reference:
+        kneighborsclassifier.py:45 — class count = max(x)+1)."""
+        sanitize_in(x)
+        n_features = int(jnp.max(x.larray)) + 1
+        onehot = (
+            x.larray.reshape(-1)[:, None] == jnp.arange(n_features)[None, :]
+        ).astype(jnp.float32)
+        split = x.split if x.split in (None, 0) else 0
+        phys = x.comm.shard(onehot, split) if split is not None else onehot
+        return DNDarray(
+            phys,
+            tuple(int(s) for s in onehot.shape),
+            types.float32,
+            split,
+            x.device,
+            x.comm,
+        )
+
     def fit(self, x: DNDarray, y: DNDarray) -> "KNeighborsClassifier":
         """Store training data and labels (reference:
         kneighborsclassifier.py fit). ``y`` may be 1-D labels or one-hot."""

@@ -405,7 +405,14 @@ class _SelfCommunication(MeshCommunication):
         super().__init__(None)  # lazy, like the world
 
     def _resolve_devices(self) -> list:
-        return _platform_devices(None)[:1]
+        import jax as _jax
+
+        devs = _platform_devices(None)
+        # in a multi-process world jax.devices()[0] belongs to process 0;
+        # MPI_COMM_SELF must be THIS process's device
+        proc = _jax.process_index()
+        local = [d for d in devs if d.process_index == proc]
+        return (local or devs)[:1]
 
 
 def _build_world() -> MeshCommunication:

@@ -342,30 +342,29 @@ class DNDarray:
             return self
         return DNDarray(casted, self.__gshape, dtype, self.__split, self.__device, self.__comm)
 
-    def __host_physical(self) -> np.ndarray:
-        """Global PHYSICAL array on the host (bf16 upcast to f32). In
-        multi-process mode the array spans non-addressable devices; the
-        host copy comes from a cross-process allgather (the analog of the
-        reference's Allgatherv in resplit(None)). Shared by numpy()/cpu()."""
+    def __host_logical(self) -> np.ndarray:
+        """Global LOGICAL array on the host (bf16 upcast to f32, pad
+        sliced off). In multi-process mode the array spans non-addressable
+        devices; the host copy comes from a cross-process allgather (the
+        analog of the reference's Allgatherv in resplit(None)). Shared by
+        numpy()/cpu() so no caller can forget the pad slice."""
         arr = self.__array
         if self.__dtype is types.bfloat16:
             arr = arr.astype(jnp.float32)
         if jax.process_count() > 1 and not arr.is_fully_addressable:
             from jax.experimental import multihost_utils
 
-            return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
-        return np.asarray(jax.device_get(arr))
+            host = np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+        else:
+            host = np.asarray(jax.device_get(arr))
+        if host.shape != tuple(self.__gshape):
+            host = host[tuple(slice(0, s) for s in self.__gshape)]
+        return host
 
     def numpy(self) -> np.ndarray:
         """Global array as numpy (reference dndarray.py:1168: resplit(None)
         + local numpy; here a device-to-host gather, pad sliced on host)."""
-        from . import _padding
-
-        host = self.__host_physical()
-        if self.__split is not None and host.shape != self.__gshape:
-            sl = tuple(slice(0, s) for s in self.__gshape)
-            host = host[sl]
-        return host
+        return self.__host_logical()
 
     def __array__(self, dtype=None) -> np.ndarray:
         out = self.numpy()
@@ -903,14 +902,9 @@ class DNDarray:
         if self.__device.device_type == "cpu":
             return self
         comm = MeshCommunication(cpu_device.jax_devices()[: max(1, self.__comm.size)])
-        # shared gather helper: multi-process arrays span non-addressable
-        # devices and need the cross-process allgather numpy() uses
-        host = self.__host_physical()
-        if host.shape != tuple(self.__gshape):
-            # slice the source mesh's pad off: the cpu comm re-pads for
-            # ITS size (which may differ from the source mesh's)
-            host = host[tuple(slice(0, s) for s in self.__gshape)]
-        arr = jnp.asarray(host)
+        # shared gather helper: cross-process allgather + pad slice (the
+        # cpu comm re-pads for ITS size, which may differ from the source)
+        arr = jnp.asarray(self.__host_logical())
         if self.__dtype is types.bfloat16:
             arr = arr.astype(jnp.bfloat16)
         arr = comm.shard(arr, self.__split)

@@ -173,6 +173,46 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
             labels, gshape, types.canonical_heat_type(labels.dtype), split, x.device, x.comm
         )
 
+    def logsumexp(self, a, axis=None, b=None, keepdims=False, return_sign=False):
+        """log(sum(b * exp(a))) computed stably (reference gaussianNB.py:398,
+        adapted from scikit-learn). Returns (out, sign) when
+        ``return_sign=True``."""
+        from ..core.dndarray import DNDarray
+        from ..core import types as _types
+
+        arr = a.larray if isinstance(a, DNDarray) else jnp.asarray(a)
+        bw = None
+        if b is not None:
+            bw = b.larray if isinstance(b, DNDarray) else jnp.asarray(b)
+        out = jax.scipy.special.logsumexp(
+            arr, axis=axis, b=bw, keepdims=keepdims, return_sign=return_sign
+        )
+        def wrap(v):
+            v = jnp.asarray(v)
+            ref = a if isinstance(a, DNDarray) else None
+            if ref is None:
+                return v
+            split = ref.split
+            if split is not None:
+                axes = (
+                    tuple(range(ref.ndim)) if axis is None
+                    else (axis,) if isinstance(axis, int) else tuple(axis)
+                )
+                axes = tuple(ax % ref.ndim for ax in axes)
+                if split in axes:
+                    split = None  # reduced away
+                elif not keepdims:
+                    split -= sum(1 for ax in axes if ax < split)
+            phys = ref.comm.shard(v, split) if split is not None else v
+            return DNDarray(
+                phys, tuple(int(s) for s in v.shape),
+                _types.canonical_heat_type(v.dtype), split, ref.device, ref.comm,
+            )
+        if return_sign:
+            out, sign = out
+            return wrap(out), wrap(sign)
+        return wrap(out)
+
     def predict_log_proba(self, x: DNDarray) -> DNDarray:
         """Normalized class log-probabilities (reference logsumexp at
         gaussianNB.py:398)."""

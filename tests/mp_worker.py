@@ -66,4 +66,12 @@ l0 = float(opt.step(x, yb))
 l1 = float(opt.step(x, yb))
 assert np.isfinite(l0) and l1 < l0, (l0, l1)
 
+# MPI_SELF must resolve to THIS process's device (jax.devices()[0]
+# belongs to process 0; using it on process 1 would be non-addressable)
+self_comm = ht.MPI_SELF
+assert self_comm.size == 1
+assert self_comm.devices[0].process_index == jax.process_index(), self_comm.devices
+z = ht.arange(5, split=0, comm=self_comm)
+assert float(ht.sum(z)) == 10.0
+
 print(f"[p{proc_id}] MULTIHOST_OK", flush=True)

@@ -285,3 +285,38 @@ class TestMatmulPrecision:
         with jax.default_matmul_precision("highest"):
             got2 = ht.matmul(x, y)
         np.testing.assert_allclose(np.asarray(got2.numpy()), a @ a.T, rtol=1e-5, atol=1e-5)
+
+
+class TestMethodParity:
+    """Class-method-level parity closures from the method audit."""
+
+    def test_knn_one_hot_encoding(self):
+        y = ht.array(np.array([0, 2, 1, 2], np.int32), split=0)
+        oh = ht.classification.KNeighborsClassifier.one_hot_encoding(y)
+        np.testing.assert_array_equal(
+            np.asarray(oh.numpy()), [[1, 0, 0], [0, 0, 1], [0, 1, 0], [0, 0, 1]]
+        )
+
+    def test_gaussiannb_logsumexp(self):
+        import scipy.special
+
+        a = np.random.default_rng(0).standard_normal((5, 4)).astype(np.float32)
+        nb = ht.naive_bayes.GaussianNB()
+        out = nb.logsumexp(ht.array(a, split=0), axis=1)
+        np.testing.assert_allclose(
+            np.asarray(out.numpy()), scipy.special.logsumexp(a, axis=1), rtol=1e-5
+        )
+        out2, sign = nb.logsumexp(
+            ht.array(a, split=0), axis=0, b=ht.array(np.abs(a)), return_sign=True
+        )
+        ref2, refsign = scipy.special.logsumexp(a, axis=0, b=np.abs(a), return_sign=True)
+        np.testing.assert_allclose(np.asarray(out2.numpy()), ref2, rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(sign.numpy()), refsign)
+
+    def test_dcsr_global_aliases(self):
+        import scipy.sparse as sp
+
+        csr = sp.csr_matrix(np.eye(5, dtype=np.float32))
+        m = ht.sparse.sparse_csr_matrix(csr, split=0)
+        np.testing.assert_array_equal(np.asarray(m.gdata), np.asarray(m.data))
+        np.testing.assert_array_equal(np.asarray(m.gindices), np.asarray(m.indices))
