@@ -8,38 +8,93 @@ under the driver) and prints ONE JSON line::
 
 Headline metric: ``hsvd_rank`` GB/s/chip (BASELINE.json north star).
 
-``vs_baseline`` compares against the reference's compute engine executing
-the same workload: single-process reference Heat short-circuits all MPI
-paths and runs plain torch CPU kernels (torch.linalg.svd is exactly
-``compute_local_truncated_svd``, reference svdtools.py:477). mpi4py is not
-installed in this image, so the reference itself cannot run; torch-CPU is
-the closest faithful stand-in. Baseline timings are measured once with
-``python bench.py --measure-baseline`` and cached in BENCH_BASELINE.json.
+Two kinds of rows in ``detail``:
+
+* **cb-parity rows** (matmul n=3000, qr n=2000, …) replicate the
+  reference's continuous-benchmark configurations and carry
+  ``speedup_vs_torch_cpu`` against the reference's compute engine:
+  single-process reference Heat short-circuits all MPI paths and runs
+  plain torch CPU kernels (torch.linalg.svd IS
+  ``compute_local_truncated_svd``, reference svdtools.py:477); mpi4py is
+  absent in this image so torch-CPU is the closest faithful stand-in.
+  The container exposes ONE CPU core (`nproc` = 1), so the torch
+  baseline is single-threaded — that is the container's honest
+  capability, not a handicap, but it means these ratios measure
+  chip-vs-one-core and cannot carry a "matching-or-beating" claim alone.
+
+* **chip rows** (``*_8k``, ``*_16k``, ``*_1gb``, ``hsvd_2gb``) are sized
+  to saturate the v5e and carry absolute-utilization fields instead:
+  ``mfu`` (fraction of the 197 TFLOP/s bf16 MXU peak) for compute-bound
+  rows and ``hbm_frac`` (fraction of the 819 GB/s HBM stream peak) for
+  memory-bound rows. These carry the performance argument.
+
+Measurement methodology — what the remote-execution tunnel breaks and
+how each ``method`` field answers it:
+
+* ``jax.block_until_ready`` is a no-op over the tunnel; completion is
+  forced by a scalar host read-back whose latency floats between ~60 and
+  ~130 ms WITHIN one run. A floor constant measured at startup therefore
+  fabricates per-op times (round-3 incident: a 6 ms matmul "measured"
+  past the chip's roofline at 154% MFU).
+* repeated identical calls whose intermediate outputs are never read can
+  be elided on the remote end (dead-compute elimination): an
+  amortization loop of independent ``f(x)`` calls measured NEGATIVE
+  marginal cost per op. Every measurement below therefore either chains
+  a data dependency through all iterations or loops INSIDE one compiled
+  program.
+
+Methods:
+
+* ``loop-program``: the op body runs k iterations inside one jitted
+  ``lax.fori_loop`` with a loop-carried dependency — one dispatch, k
+  serial device executions. Per-op time is the slope between a short and
+  a long loop, cancelling sync latency, dispatch cost, and cache-lookup
+  constants. Purest device rate; used for the chip rows.
+* ``chained-slope``: public API calls with each call consuming the
+  previous call's output (dispatch cost included — that is what a user
+  pays), timed as the same two-point slope, median over reps. Used for
+  the cb rows.
+* ``wallclock``: host-driven composites with internal syncs (full KMeans
+  fit). Plain best-of wall-clock.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import os
+import statistics
 import sys
 import time
 
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
 
-# workload sizes (single chip; reference cb sizes where they fit)
+# --------------------------------------------------------------------- #
+# v5e single-chip peaks (per-chip accounting for mfu / hbm_frac)        #
+# --------------------------------------------------------------------- #
+V5E_BF16_FLOPS = 197e12   # MXU peak, bf16 multiply / f32 accumulate
+V5E_HBM_BPS = 819e9       # HBM stream peak
+
+# cb-parity workload sizes (reference cb configurations)
 N_MATMUL = 3000          # benchmarks/cb/linalg.py:45
 N_QR = 2000              # benchmarks/cb/linalg.py:55
 HSVD_M, HSVD_N, HSVD_R = 16384, 2048, 10   # torch-comparable baseline workload
-HSVD_BIG_M, HSVD_BIG_N = 65536, 8192       # 2.1 GB — the north-star per-chip shard
-                                           # (200 GB over v5e-64 ~ 3 GB/chip); no
-                                           # torch baseline: a full CPU SVD at this
-                                           # size is O(days)
 KM_N, KM_D, KM_K = 1_048_576, 64, 8        # KMeans iter/s at scale
 RESHAPE_SHAPE = (1000, 250_000)            # cb uses 1000x10M..40M on a cluster
 CONCAT_SIZES = (10_000, 20_000, 40_000)    # benchmarks/cb/manipulations.py:20
 SUM_N = 100_000_000
 SORT_N = 16_777_216                        # distributed sort (values+indices)
-RA_B, RA_H, RA_S, RA_D = 4, 8, 4096, 64    # ring attention workload
+RA_B, RA_H, RA_S, RA_D = 4, 8, 4096, 64    # cb-scale ring attention workload
+
+# chip-saturating workload sizes
+MM_8K = 8192                                   # bf16 matmul at MXU-saturating size
+HSVD_BIG_M, HSVD_BIG_N = 65536, 8192           # 2.1 GB — the north-star per-chip
+                                               # shard (200 GB over v5e-64 ~ 3 GB)
+RAB_B, RAB_H, RAB_S, RAB_D = 1, 8, 16384, 128  # long-context attention, 16k tokens
+SUM_BIG_N = 268_435_456                        # 1.07 GB reduction
+SORT_BIG_N = 134_217_728                       # 0.54 GB sort (values + argsort)
+CHAIN_N = 67_108_865                           # 256 MB/pass; odd length exercises
+                                               # the pad-inside-jit path
 
 
 def _best_of(fn, reps: int = 3) -> float:
@@ -52,38 +107,62 @@ def _best_of(fn, reps: int = 3) -> float:
     return best
 
 
-def _best_of_amortized(fn, sync, reps: int = 3, inner: int = 4, floor: float = 0.0) -> float:
-    """Per-execution time with the host-readback latency floor amortized
-    out: each sample issues ``inner`` dependent-free dispatches (they
-    serialize on the device stream) and syncs ONCE on the last output.
-    Over the remote-execution tunnel a single scalar read-back costs
-    ~90 ms — without amortization every sub-90ms workload reads as 90 ms.
-    """
-    return _best_of_amortized_group({"x": fn}, sync, reps=reps, inner=inner, floor=floor)["x"]
+def _chained_slope_group(members, sync, k1, k2, reps=5):
+    """Two-point slope timing for a GROUP of directly-compared chained
+    workloads, interleaved within the same rep loop so every member sees
+    the same tunnel weather.
 
-
-def _best_of_amortized_group(fns: dict, sync, reps: int = 6, inner: int = 16, floor: float = 0.0) -> dict:
-    """Amortized timing for a GROUP of directly-compared workloads,
-    interleaved within the same rep loop so every member sees the same
-    tunnel weather — back-to-back separate measurements over the remote
-    tunnel can differ 5-10x from drift alone, which fabricates ratios.
+    ``members``: {name: (init_state, step)} where ``step(state) -> state``
+    must consume its input (the data dependency defeats remote
+    dead-compute elimination and forces serial execution). Per-op time is
+    ``(T(k2) - T(k1)) / (k2 - k1)`` — the sync read-back, dispatch-queue
+    constants and anything else independent of iteration count cancels.
+    Median over reps rejects weather outliers.
     """
-    for fn in fns.values():
-        sync(fn())  # warmup / compile
-    best = {k: float("inf") for k in fns}
+    for name, (init, step) in members.items():
+        sync(step(init))  # warmup / compile
+    ests = {k: [] for k in members}
     for _ in range(reps):
-        for k, fn in fns.items():
+        for name, (init, step) in members.items():
+            y = init
             t0 = time.perf_counter()
-            out = None
-            for _ in range(inner):
-                out = fn()
-            sync(out)
-            best[k] = min(best[k], time.perf_counter() - t0)
-    out = {}
-    for k, b in best.items():
-        per_op = (b - floor) / inner
-        out[k] = per_op if per_op > 0 else b / inner
-    return out
+            for _ in range(k1):
+                y = step(y)
+            sync(y)
+            t1 = time.perf_counter()
+            y = init
+            for _ in range(k2):
+                y = step(y)
+            sync(y)
+            t2 = time.perf_counter()
+            ests[name].append(((t2 - t1) - (t1 - t0)) / (k2 - k1))
+    return {k: max(statistics.median(v), 1e-9) for k, v in ests.items()}
+
+
+def _chained_slope(init, step, sync, k1, k2, reps=5) -> float:
+    return _chained_slope_group({"x": (init, step)}, sync, k1, k2, reps)["x"]
+
+
+def _loop_program_time(make_looped, args, sync, k1, k2, reps=5) -> float:
+    """Per-iteration device time of a loop-carried body compiled as ONE
+    program per loop length: slope between the k1- and k2-iteration
+    executables. ``make_looped(k) -> jitted fn(*args)``."""
+    f1, f2 = make_looped(k1), make_looped(k2)
+    sync(f1(*args))
+    sync(f2(*args))
+    est = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(f1(*args))
+        t1 = time.perf_counter()
+        sync(f2(*args))
+        t2 = time.perf_counter()
+        est.append(((t2 - t1) - (t1 - t0)) / (k2 - k1))
+    return max(statistics.median(est), 1e-9)
+
+
+def _progress(name, seconds):
+    print(f"[bench] {name}: {seconds*1e3:.3f} ms", file=sys.stderr, flush=True)
 
 
 # --------------------------------------------------------------------- #
@@ -143,7 +222,9 @@ def measure_baseline() -> dict:
         "engine": "torch-cpu",
         "torch": torch.__version__,
         "threads": torch.get_num_threads(),
-        "note": "reference Heat single-process == local torch kernels (mpi4py absent)",
+        "cpus_visible": os.cpu_count(),
+        "note": "reference Heat single-process == local torch kernels (mpi4py absent); "
+        "the container exposes one CPU core, so this engine is honestly single-threaded",
     }
     return out
 
@@ -153,74 +234,94 @@ def measure_baseline() -> dict:
 # --------------------------------------------------------------------- #
 def measure_heat_tpu() -> dict:
     import jax
+    import jax.numpy as jnp
+    from jax import lax
     import numpy as np
     import heat_tpu as ht
 
     def sync(x):
         # jax.block_until_ready is a no-op over the remote-execution tunnel;
-        # a scalar host read-back (~8 µs floor) forces producer completion.
+        # a scalar host read-back forces producer completion.
+        if isinstance(x, tuple):
+            x = x[0]
         arr = x._phys if hasattr(x, "_phys") else x
         np.asarray(jax.device_get(arr[(0,) * arr.ndim] if arr.ndim else arr))
 
     out = {"_meta": {"platform": jax.devices()[0].platform,
                      "device": str(jax.devices()[0]),
                      "n_devices": len(jax.devices())}}
+    method = {}
 
     ht.random.seed(0)
 
-    # host-readback latency floor of the execution tunnel (subtracted from
-    # amortized measurements; recorded for the judge)
     probe = ht.zeros((4,))
     sync(probe)
-    floor = _best_of(lambda: sync(probe), reps=5)
-    out["_meta"]["sync_floor_s"] = round(floor, 6)
+    out["_meta"]["sync_floor_s"] = round(_best_of(lambda: sync(probe), reps=5), 6)
 
-    def amortized(fn, reps=3, inner=4):
-        # inner must be large enough that total device time dwarfs the
-        # ±1 ms noise of the floor measurement, else sub-floor workloads
-        # read arbitrarily fast
-        return _best_of_amortized(fn, sync, reps=reps, inner=inner, floor=floor)
-
+    # ------------------------------------------------------------------ #
+    # cb-parity rows: chained public API calls (dispatch cost included)  #
+    # ------------------------------------------------------------------ #
+    # NOTE: f32 matmul uses JAX's DEFAULT precision on TPU = bf16 MXU
+    # passes with f32 accumulation (the same trade as torch-CUDA's tf32
+    # default), so f32≈bf16 seconds at this size is expected, not an
+    # anomaly; ht.matmul(precision="highest") buys exact f32 at ~3x.
+    # Chained matmuls overflow to inf after ~20 steps — TPU arithmetic on
+    # inf/nan runs at identical speed (fixed-function MXU), so timing is
+    # unaffected.
     a = ht.random.random((N_MATMUL, N_MATMUL), split=0)
     b = ht.random.random((N_MATMUL, N_MATMUL), split=0)
-    a1 = a.resplit(1); b1 = b.resplit(1)
+    b1 = b.resplit(1)
     abf = a.astype(ht.bfloat16); bbf = b.astype(ht.bfloat16)
-    # the f32/bf16 pair is compared (gflops ratio) -> interleave them
-    mm = _best_of_amortized_group(
+    mm = _chained_slope_group(
         {
-            "f32": lambda: ht.matmul(a, b),
-            "split1": lambda: ht.matmul(a1, b1),
-            "bf16": lambda: ht.matmul(abf, bbf),
+            "f32": (a, lambda y: ht.matmul(y, b)),
+            "split1": (a.resplit(1), lambda y: ht.matmul(y, b1)),
+            "bf16": (abf, lambda y: ht.matmul(y, bbf)),
         },
-        sync, reps=6, inner=32, floor=floor,
+        sync, k1=8, k2=72, reps=5,
     )
     out["matmul"] = mm["f32"]
+    _progress("matmul", out["matmul"])
     out["matmul_split1"] = mm["split1"]
+    _progress("matmul_split1", out["matmul_split1"])
     out["matmul_bf16"] = mm["bf16"]
-    del a, b, a1, b1, abf, bbf
+    _progress("matmul_bf16", out["matmul_bf16"])
+    method["matmul"] = method["matmul_split1"] = method["matmul_bf16"] = "chained-slope"
+    del a, b, b1, abf, bbf
 
+    # QR of an orthonormal factor costs the same Householder sweep (the
+    # algorithm is data-oblivious); chaining y <- q keeps the dependency
     c0 = ht.random.random((N_QR, N_QR), split=0)
-    out["qr"] = amortized(lambda: ht.linalg.qr(c0)[0], reps=5, inner=8)
+    out["qr"] = _chained_slope(c0, lambda y: ht.linalg.qr(y)[0], sync, k1=4, k2=28)
+    _progress("qr", out["qr"])
+    method["qr"] = "chained-slope"
     del c0
 
+    # hsvd returns (m, r); chain by writing a result-derived value into
+    # one element of the input (cheap at[].set, full dependency)
     d = ht.random.random((HSVD_M, HSVD_N), split=0)
-    out["hsvd"] = amortized(lambda: ht.linalg.hsvd_rank(d, HSVD_R)[0], reps=8, inner=16)
+    def _hsvd_step(y):
+        u, err = ht.linalg.hsvd_rank(y, HSVD_R)
+        y[0, 0] = err.larray * 1e-30  # result-derived write, no host sync
+        return y
+    out["hsvd"] = _chained_slope(d, _hsvd_step, sync, k1=4, k2=20)
+    _progress("hsvd", out["hsvd"])
+    method["hsvd"] = "chained-slope"
     del d
-
-    # headline: the same op at the north-star per-chip shard size
-    dbig = ht.random.randn(HSVD_BIG_M, HSVD_BIG_N, split=0)
-    out["hsvd_2gb"] = amortized(lambda: ht.linalg.hsvd_rank(dbig, HSVD_R)[0], reps=6, inner=4)
-    del dbig
 
     from heat_tpu.cluster.kmeans import _lloyd_step
     x = ht.random.randn(KM_N, KM_D, split=0)
-    cent = x.larray[:KM_K]
+    cent0 = x.larray[:KM_K]
     step = _lloyd_step(KM_K, tuple(x.larray.shape), np.dtype(x.larray.dtype).name)
-    out["kmeans_iter"] = amortized(lambda: step(x.larray, cent)[0], reps=6, inner=32)
-    del x, cent
+    # Lloyd's iteration is naturally chained: centroids feed back
+    out["kmeans_iter"] = _chained_slope(
+        cent0, lambda c: step(x.larray, c)[0], sync, k1=8, k2=40
+    )
+    method["kmeans_iter"] = "chained-slope"
+    del x, cent0
 
     # cb cluster config: full fit on 4x5000 spherical samples, kmeans++
-    # (host-driven convergence loop: measured end-to-end, no amortization)
+    # (host-driven convergence loop with internal syncs)
     from heat_tpu.utils.data.spherical import create_spherical_dataset
     data = create_spherical_dataset(num_samples_cluster=5000, radius=1.0, offset=4.0,
                                     dtype=ht.float32, random_state=1)
@@ -229,67 +330,166 @@ def measure_heat_tpu() -> dict:
         km.fit(data)
         sync(km.cluster_centers_)
     out["kmeans_fit_cb"] = _best_of(_km_fit, reps=2)
+    _progress("kmeans_fit_cb", out["kmeans_fit_cb"])
+    method["kmeans_fit_cb"] = "wallclock"
     del data
 
+    # reshape there-and-back per step = 2 ops; slope halved
     r = ht.zeros(RESHAPE_SHAPE, split=1)
-    out["reshape"] = amortized(lambda: ht.reshape(r, (10_000_000, -1), new_split=1), reps=2, inner=8)
+    out["reshape"] = _chained_slope(
+        r,
+        lambda y: ht.reshape(ht.reshape(y, (10_000_000, -1), new_split=1),
+                             RESHAPE_SHAPE, new_split=1),
+        sync, k1=2, k2=10,
+    ) / 2
+    method["reshape"] = "chained-slope (pair, halved)"
     del r
 
+    # concatenate + a dependency slice per step = concat op + cheap slice
     arrs = [ht.zeros((1000, s), split=(None if i == 1 else 1)) for i, s in enumerate(CONCAT_SIZES)]
-    out["concatenate"] = amortized(lambda: ht.concatenate(arrs, axis=1), reps=2, inner=16)
+    def _concat_step(y):
+        c = ht.concatenate([y, arrs[1], arrs[2]], axis=1)
+        return c[:, : CONCAT_SIZES[0]]
+    out["concatenate"] = _chained_slope(arrs[0], _concat_step, sync, k1=4, k2=24)
+    _progress("concatenate", out["concatenate"])
+    method["concatenate"] = "chained-slope (includes one dependency slice)"
     del arrs
 
+    # reductions cannot chain at the API level (scalar out): loop-program
+    # with the accumulator folded into the (single) read pass
     s_in = ht.arange(SUM_N, dtype=ht.float32, split=0)
-    out["sum"] = amortized(lambda: ht.sum(s_in), inner=32)
+    @functools.lru_cache(maxsize=None)
+    def _sum_loop(k):
+        def run(v):
+            # acc feeds back into the summand: not loop-invariant, still
+            # exactly one stream over v per iteration (add fuses into the
+            # reduction read)
+            return lax.fori_loop(
+                0, k, lambda i, acc: jnp.sum(v + acc * 1e-30), jnp.float32(0)
+            )
+        return jax.jit(run)
+    out["sum"] = _loop_program_time(_sum_loop, (s_in._phys,), sync, k1=4, k2=68)
+    _progress("sum", out["sum"])
+    method["sum"] = "loop-program"
     del s_in
 
     # public ht.sort: values AND argsort indices (the reference returns
-    # both); the values-only half-traffic path is what percentile uses
+    # both); sorting its own sorted output costs the same network (the
+    # sort is data-oblivious)
     srt = ht.random.randn(SORT_N, split=0)
-    out["sort"] = amortized(lambda: ht.sort(srt)[0], reps=4, inner=4)
+    out["sort"] = _chained_slope(srt, lambda y: ht.sort(y)[0], sync, k1=2, k2=8, reps=4)
+    _progress("sort", out["sort"])
+    method["sort"] = "chained-slope"
     del srt
 
-    # ring attention: sequence-parallel exact attention (single chip = dense
-    # flash-style path); B=4, H=8, S=4096, D=64 causal
+    # ring attention: output feeds back as the next query
     qkv = [ht.random.randn(RA_B, RA_H, RA_S, RA_D, split=2) for _ in range(3)]
     qkv_bf = [t.astype(ht.bfloat16) for t in qkv]
-    # interleaved (compared pair); inner large enough that the ms-scale
-    # kernels dwarf the sync-floor noise, else the metric reads above peak
-    ra = _best_of_amortized_group(
+    ra = _chained_slope_group(
         {
-            "f32": lambda: ht.nn.ring_attention(*qkv, causal=True),
-            "bf16": lambda: ht.nn.ring_attention(*qkv_bf, causal=True),
+            "f32": (qkv[0], lambda y: ht.nn.ring_attention(y, qkv[1], qkv[2], causal=True)),
+            "bf16": (qkv_bf[0], lambda y: ht.nn.ring_attention(y, qkv_bf[1], qkv_bf[2], causal=True)),
         },
-        sync, reps=4, inner=32, floor=floor,
+        sync, k1=8, k2=40, reps=4,
     )
     out["ring_attention"] = ra["f32"]
+    _progress("ring_attention", out["ring_attention"])
     out["ring_attention_bf16"] = ra["bf16"]
+    _progress("ring_attention_bf16", out["ring_attention_bf16"])
+    method["ring_attention"] = method["ring_attention_bf16"] = "chained-slope"
     del qkv, qkv_bf
 
+    # ------------------------------------------------------------------ #
+    # chip rows: loop programs (pure device rate) unless noted           #
+    # ------------------------------------------------------------------ #
+    @functools.lru_cache(maxsize=None)
+    def _mm_loop(k):
+        # y <- (y * 1e-4) @ r : loop-carried, scale fuses into the matmul
+        return jax.jit(lambda y, r: lax.fori_loop(0, k, lambda i, y: (y * 1e-4) @ r, y))
+
+    am = ht.random.randn(MM_8K, MM_8K, split=0).astype(ht.bfloat16)
+    af = ht.random.randn(MM_8K, MM_8K, split=0)
+    out["matmul_bf16_8k"] = _loop_program_time(_mm_loop, (am._phys, am._phys), sync, k1=4, k2=36)
+    _progress("matmul_bf16_8k", out["matmul_bf16_8k"])
+    out["matmul_f32_8k"] = _loop_program_time(_mm_loop, (af._phys, af._phys), sync, k1=4, k2=36)
+    _progress("matmul_f32_8k", out["matmul_f32_8k"])
+    method["matmul_bf16_8k"] = method["matmul_f32_8k"] = "loop-program"
+    del am, af
+
+    # long-context attention keeps the PUBLIC path (the Mosaic flash
+    # kernel is an AOT executable the wrapper dispatches; a loop program
+    # would silently fall back to the slower blocked program)
+    qkv_big = [
+        ht.random.randn(RAB_B, RAB_H, RAB_S, RAB_D, split=2).astype(ht.bfloat16)
+        for _ in range(3)
+    ]
+    out["ring_attention_16k_bf16"] = _chained_slope(
+        qkv_big[0],
+        lambda y: ht.nn.ring_attention(y, qkv_big[1], qkv_big[2], causal=True),
+        sync, k1=4, k2=28, reps=5,
+    )
+    method["ring_attention_16k_bf16"] = "chained-slope"
+    del qkv_big
+
+    # headline: hsvd_rank at the north-star per-chip shard (2.1 GB), the
+    # jitted 4-pass sketch core in a loop program; the public wrapper
+    # adds one cached-jit dispatch (~0.1 ms of ~14 ms)
+    from heat_tpu.core.linalg.svdtools import _sketched_uds
+    dbig = ht.random.randn(HSVD_BIG_M, HSVD_BIG_N, split=0)
+    @functools.lru_cache(maxsize=None)
+    def _hsvd_loop(k):
+        def body(i, y):
+            # want_left=True mirrors the public split=0 rank path, which
+            # returns U of the input orientation directly from the sketch
+            u, s, err_sq, norm_sq = _sketched_uds(y, HSVD_R + 5, HSVD_R + 15, want_left=True)
+            # result-derived single-element write keeps the dependency;
+            # in-place on the loop carry
+            return y.at[0, 0].set(y[0, 0] + err_sq * 1e-30)
+        return jax.jit(lambda y: lax.fori_loop(0, k, body, y))
+    out["hsvd_2gb"] = _loop_program_time(_hsvd_loop, (dbig._phys,), sync, k1=2, k2=12)
+    _progress("hsvd_2gb", out["hsvd_2gb"])
+    method["hsvd_2gb"] = "loop-program"
+    del dbig
+
+    sb = ht.arange(SUM_BIG_N, dtype=ht.float32, split=0)
+    out["sum_1gb"] = _loop_program_time(_sum_loop, (sb._phys,), sync, k1=4, k2=68)
+    _progress("sum_1gb", out["sum_1gb"])
+    method["sum_1gb"] = "loop-program"
+    del sb
+
+    srtb = ht.random.randn(SORT_BIG_N, split=0)
+    out["sort_1gb"] = _chained_slope(srtb, lambda y: ht.sort(y)[0], sync, k1=1, k2=3, reps=3)
+    _progress("sort_1gb", out["sort_1gb"])
+    method["sort_1gb"] = "chained-slope"
+    del srtb
+
     # op-dispatch overhead: a chained elementwise expression through the
-    # ht.* wrappers vs ONE hand-jitted jnp program on the same physical
-    # array. Odd length exercises the pad-inside-jit path. The ht chain is
-    # 3 jitted dispatches vs 1 fused program — the ratio is the dispatch+
-    # fusion overhead VERDICT r1 item 6 asks to bound.
-    import jax.numpy as jnp
-    e = ht.random.randn(4_000_001, split=0)
-    phys = e._phys
+    # ht.* wrappers vs the same 3 eager jnp dispatches vs ONE hand-jitted
+    # fused program — all three feed their output back in (values run to
+    # inf/nan; TPU element rate is value-independent). 64M elements so
+    # device time (≈2 ms/pass) dominates dispatch cost.
+    e = ht.random.randn(CHAIN_N, split=0)
     fused = jax.jit(lambda v: jnp.exp(jnp.sin(v) * 2.0 + v))
-    chain = _best_of_amortized_group(
+    chain = _chained_slope_group(
         {
-            "ht": lambda: ht.exp(ht.sin(e) * 2.0 + e),
+            "ht": (e, lambda y: ht.exp(ht.sin(y) * 2.0 + y)),
             # raw unfused jnp (same 3 dispatches): isolates the WRAPPER overhead
-            "raw": lambda: jnp.exp(jnp.sin(phys) * 2.0 + phys),
+            "raw": (e._phys, lambda y: jnp.exp(jnp.sin(y) * 2.0 + y)),
             # single fused program: the fusion gap any 3-call chain pays
-            "fused": lambda: fused(phys),
+            "fused": (e._phys, fused),
         },
-        sync, reps=6, inner=32, floor=floor,
+        sync, k1=8, k2=40, reps=5,
     )
     out["op_chain"] = chain["ht"]
+    _progress("op_chain", out["op_chain"])
     out["op_chain_raw_jnp"] = chain["raw"]
+    _progress("op_chain_raw_jnp", out["op_chain_raw_jnp"])
     out["op_chain_fused_jnp"] = chain["fused"]
-    del e, phys
+    _progress("op_chain_fused_jnp", out["op_chain_fused_jnp"])
+    method["op_chain"] = method["op_chain_raw_jnp"] = method["op_chain_fused_jnp"] = "chained-slope"
+    del e
 
+    out["_method"] = method
     return out
 
 
@@ -306,6 +506,9 @@ def main() -> None:
     if os.path.exists(BASELINE_FILE):
         with open(BASELINE_FILE) as f:
             base = json.load(f)
+
+    on_tpu = ours["_meta"]["platform"] == "tpu"
+    method = ours.get("_method", {})
 
     hsvd_bytes = HSVD_M * HSVD_N * 4
     hsvd_gbps = hsvd_bytes / ours["hsvd"] / 1e9
@@ -324,30 +527,64 @@ def main() -> None:
         # new_split=1 does real repartition work — not comparable.
         if bkey and base.get(bkey) and k != "reshape":
             entry["speedup_vs_torch_cpu"] = round(base[bkey] / t_ours, 3)
+        if k in method:
+            entry["method"] = method[k]
         detail[k] = entry
-    # derived throughputs
-    detail["matmul"]["gflops"] = round(2 * N_MATMUL**3 / ours["matmul"] / 1e9, 1)
-    if ours.get("matmul_bf16"):
-        detail["matmul_bf16"]["gflops"] = round(2 * N_MATMUL**3 / ours["matmul_bf16"] / 1e9, 1)
-    if ours.get("op_chain_raw_jnp"):
-        detail["op_chain"]["overhead_vs_raw_jnp"] = round(
-            ours["op_chain"] / ours["op_chain_raw_jnp"], 3
-        )
-    if ours.get("op_chain_fused_jnp"):
-        detail["op_chain"]["overhead_vs_fused_jnp"] = round(
-            ours["op_chain"] / ours["op_chain_fused_jnp"], 3
-        )
+
+    def mfu(key, flops):
+        detail[key]["tflops"] = round(flops / ours[key] / 1e12, 2)
+        if on_tpu:
+            detail[key]["mfu"] = round(flops / ours[key] / V5E_BF16_FLOPS, 3)
+
+    def hbm(key, nbytes):
+        detail[key]["gbps"] = round(nbytes / ours[key] / 1e9, 2)
+        if on_tpu:
+            detail[key]["hbm_frac"] = round(nbytes / ours[key] / V5E_HBM_BPS, 3)
+
+    # cb-parity derived throughputs
+    mfu("matmul", 2 * N_MATMUL**3)
+    mfu("matmul_bf16", 2 * N_MATMUL**3)
     detail["kmeans_iter"]["iter_per_s"] = round(1.0 / ours["kmeans_iter"], 2)
-    if ours.get("sort"):
-        detail["sort"]["melem_per_s"] = round(SORT_N / ours["sort"] / 1e6, 1)
-    for ra_key in ("ring_attention", "ring_attention_bf16"):
-        if ours.get(ra_key):
-            # 2 matmuls of (S,D)x(D,S) and (S,S)x(S,D) per head, causal ~ half
-            flops = RA_B * RA_H * 2 * 2 * RA_S * RA_S * RA_D * 0.5
-            detail[ra_key]["tflops"] = round(flops / ours[ra_key] / 1e12, 2)
-    detail["sum"]["gbps"] = round(SUM_N * 4 / ours["sum"] / 1e9, 2)
+    detail["sort"]["melem_per_s"] = round(SORT_N / ours["sort"] / 1e6, 1)
+    ra_flops = RA_B * RA_H * 2 * 2 * RA_S * RA_S * RA_D * 0.5  # causal ~ half
+    mfu("ring_attention", ra_flops)
+    mfu("ring_attention_bf16", ra_flops)
+    hbm("sum", SUM_N * 4)
     detail["hsvd"]["gbps"] = round(hsvd_gbps, 2)
+
+    # chip rows
+    mfu("matmul_bf16_8k", 2 * MM_8K**3)
+    mfu("matmul_f32_8k", 2 * MM_8K**3)
+    mfu("ring_attention_16k_bf16", RAB_B * RAB_H * 2 * 2 * RAB_S * RAB_S * RAB_D * 0.5)
     detail["hsvd_2gb"]["gbps"] = round(hsvd_big_gbps, 2)
+    # the 4-pass sketch reads A four times: algorithmic stream utilization
+    detail["hsvd_2gb"]["passes_over_A"] = 4
+    if on_tpu:
+        detail["hsvd_2gb"]["hbm_frac_algorithmic"] = round(
+            4 * HSVD_BIG_M * HSVD_BIG_N * 4 / ours["hsvd_2gb"] / V5E_HBM_BPS, 3
+        )
+    hbm("sum_1gb", SUM_BIG_N * 4)
+    # sort is a multi-pass O(n log n) kernel — element rate, not a
+    # single-stream utilization, is its honest unit
+    detail["sort_1gb"]["melem_per_s"] = round(SORT_BIG_N / ours["sort_1gb"] / 1e6, 1)
+
+    detail["op_chain"]["overhead_vs_raw_jnp"] = round(
+        ours["op_chain"] / ours["op_chain_raw_jnp"], 3
+    )
+    detail["op_chain"]["overhead_vs_fused_jnp"] = round(
+        ours["op_chain"] / ours["op_chain_fused_jnp"], 3
+    )
+    # sanity: one fused program must not lose to a 3-dispatch chain (a
+    # violation means the measurement was dispatch/tunnel-bound, not a
+    # device-time result — flagged instead of silently reported)
+    detail["op_chain"]["ordering_ok"] = bool(
+        ours["op_chain_fused_jnp"] <= min(ours["op_chain"], ours["op_chain_raw_jnp"]) * 1.1
+    )
+    # roofline credibility: a row above the chip's physical peak means the
+    # measurement (not the chip) is wrong — flag it rather than report it
+    for row in detail.values():
+        if row.get("mfu", 0) > 1.0 or row.get("hbm_frac", 0) > 1.0:
+            row["measurement_suspect"] = True
 
     result = {
         "metric": (
@@ -360,6 +597,7 @@ def main() -> None:
         "vs_baseline": round(hsvd_gbps / hsvd_base_gbps, 3) if hsvd_base_gbps else None,
         "baseline": "reference engine (torch-CPU single-process Heat path), BENCH_BASELINE.json",
         "platform": ours["_meta"],
+        "peaks": {"bf16_tflops": V5E_BF16_FLOPS / 1e12, "hbm_gbps": V5E_HBM_BPS / 1e9},
         "detail": detail,
     }
     print(json.dumps(result))

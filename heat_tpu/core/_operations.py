@@ -306,8 +306,10 @@ def __binary_op(
             t2 = t2.resplit(tgt)
         s2 = _out_split(t2)
     output_split = s1 if s1 is not None else s2
-    # a broadcast dimension of extent 1 cannot carry the split
-    if output_split is not None and output_shape[output_split] == 1:
+    # a broadcast dimension of extent 1 cannot carry the split; a
+    # zero-extent output is stored replicated (comm.shard convention),
+    # so pinning a split sharding on it would conflict
+    if output_split is not None and output_shape[output_split] <= 1:
         output_split = None
 
     comm = ref.comm

@@ -685,7 +685,44 @@ class DNDarray:
         if isinstance(key, LocalIndex):
             return self.__array[key.obj]
         if isinstance(key, DNDarray) and key.dtype == types.bool:
-            # boolean mask → data-dependent shape, evaluate eagerly
+            # boolean mask → data-dependent output shape. Distributed
+            # arrays run the gather-free per-shard count + balanced
+            # compaction (parallel.compact_select) — the reference's
+            # rank-local mask selection (dndarray.py:827-1084) with even
+            # blocks; the operand is never all-gathered. Everything else
+            # evaluates eagerly on the logical array.
+            comm = self.__comm
+            if (
+                self.__split is not None
+                and comm.is_distributed()
+                and self.ndim > 0
+                and 0 not in self.__gshape  # zero-extent arrays are stored
+                # replicated (comm.shard), which the shard_map path rejects
+            ):
+                from . import parallel as _parallel
+
+                elements = tuple(key.gshape) == tuple(self.__gshape)
+                rows = (
+                    not elements
+                    and key.ndim == 1
+                    and self.ndim > 1
+                    and key.gshape[0] == self.__gshape[0]
+                )
+                if elements or rows:
+                    arr = self if self.__split == 0 else self.resplit(0)
+                    if key.split == 0 and tuple(key._phys.shape[:1]) == tuple(arr._phys.shape[:1]):
+                        mask_phys = key._phys
+                    else:
+                        mask_phys = comm.shard(key.larray, 0)
+                    data_phys, n_sel = _parallel.compact_select(
+                        arr._phys, mask_phys, comm.mesh, comm.axis_name, rows
+                    )
+                    gshape = (n_sel,) + (tuple(self.__gshape[1:]) if rows else ())
+                    if n_sel == 0:
+                        data_phys = comm.shard(data_phys, 0)
+                    return DNDarray(
+                        data_phys, gshape, self.__dtype, 0, self.__device, comm
+                    )
             result = self.larray[key.larray]
             out_split = 0 if self.__split is not None and result.ndim > 0 else None
             gshape = tuple(int(s) for s in result.shape)
@@ -802,6 +839,25 @@ class DNDarray:
                         (0, p - g) for p, g in zip(phys.shape, self.__gshape)
                     ]
                     key = jnp.pad(jnp.asarray(key), widths)  # pad rows: False
+                if np.ndim(value) == 0:
+                    # scalar fill: a sharded where() — no boolean-index
+                    # expansion (host-concrete nonzero), so it works even
+                    # when shards span other processes
+                    self.__array = jnp.where(
+                        key, jnp.asarray(value, dtype=phys.dtype), phys
+                    )
+                    self._invalidate_caches()
+                    return
+                if not phys.is_fully_addressable:
+                    # at[mask].set with a value ARRAY expands the mask via
+                    # a concrete host-side nonzero, which cannot see
+                    # non-addressable shards — fail loudly instead of
+                    # crashing inside JAX (ADVICE r2)
+                    raise NotImplementedError(
+                        "boolean-mask assignment with a per-element value array "
+                        "is not supported in a multi-process world; use a "
+                        "scalar value or ht.where"
+                    )
                 self.__array = phys.at[key].set(value)
                 self._invalidate_caches()
                 return

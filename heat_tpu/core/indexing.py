@@ -20,8 +20,28 @@ __all__ = ["nonzero", "where"]
 
 def nonzero(x: DNDarray) -> DNDarray:
     """Indices of non-zero elements as an (nnz, ndim) array, split=0 when
-    x is distributed (reference: indexing.py nonzero)."""
+    x is distributed (reference: indexing.py nonzero — rank-local results
+    plus split offset). Distributed inputs run the gather-free per-shard
+    count + balanced-compaction schedule (``parallel.distributed_nonzero``);
+    the operand is never all-gathered."""
     sanitize_in(x)
+    comm = x.comm
+    if (
+        x.split is not None
+        and x.ndim > 0
+        and comm.is_distributed()
+        and 0 not in x.gshape  # zero-extent arrays are stored replicated
+    ):
+        from . import parallel as _parallel
+
+        arr = x if x.split == 0 else x.resplit(0)
+        phys, nnz = _parallel.distributed_nonzero(
+            arr._phys, int(arr.gshape[0]), comm.mesh, comm.axis_name
+        )
+        gshape = (nnz, x.ndim)
+        if nnz == 0:
+            return DNDarray(comm.shard(phys, 0), gshape, types.int64, 0, x.device, comm)
+        return DNDarray(phys, gshape, types.int64, 0, x.device, comm)
     idx = jnp.nonzero(x.larray)
     stacked = jnp.stack(idx, axis=1) if x.ndim > 0 else jnp.zeros((0, 0), dtype=jnp.int64)
     stacked = stacked.astype(jnp.int64)

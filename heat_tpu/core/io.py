@@ -124,6 +124,40 @@ def _assemble_sharded(read_slab, gshape, dtype, split, device, comm) -> DNDarray
     return DNDarray(arr, gshape, dtype, split, device, comm)
 
 
+def _multiprocess_gather_for_save(data: DNDarray):
+    """Multi-writer safety for saves (plain h5py/netCDF4 handles must not
+    write one file from several processes concurrently — the reference
+    relies on parallel drivers we don't have: h5py ``driver='mpio'``
+    (reference io.py:214) and netCDF4 ``parallel=True`` (io.py:585); a
+    plain multi-writer 'w' open truncates per process and corrupts).
+
+    In a multi-process world the array is allgathered (COLLECTIVE —
+    every process must call save) and only process 0 touches the file;
+    ``_sync_processes`` afterwards keeps other hosts from reading a
+    half-written file.
+
+    Returns ``(is_multiprocess, host_array_or_None)`` — the host array is
+    returned on every process (the allgather is collective) but only
+    process 0 should write it.
+    """
+    if jax.process_count() == 1:
+        return False, None
+    arr = data.numpy()  # collective cross-process allgather
+    if data.dtype is types.bfloat16:
+        arr = np.asarray(arr, dtype=np.float32)
+    return True, np.asarray(arr)
+
+
+def _sync_processes(tag: str) -> None:
+    """Cross-process barrier so no host proceeds past a save before the
+    writer (process 0) has finished — the analog of the reference's
+    trailing ``comm.Barrier()`` in its rank-ordered write loops."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
 def _write_shards(data: DNDarray, write_slab) -> None:
     """Write a DNDarray shard-by-shard: ``write_slab(global_slices,
     host_block)`` receives each device's LOGICAL block — the global array is
@@ -199,13 +233,24 @@ if __HDF5:
             )
 
     def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
-        """Save a DNDarray to HDF5 (reference: io.py:166). Writes one
-        hyperslab per device shard; the global array is never gathered."""
+        """Save a DNDarray to HDF5 (reference: io.py:166). Single-process:
+        one hyperslab write per device shard, global array never gathered.
+        Multi-process: collective allgather + single-writer (process 0) —
+        see ``_multiprocess_gather_for_save``."""
         if not isinstance(data, DNDarray):
             raise TypeError(f"data must be a DNDarray, got {type(data)}")
         if not isinstance(path, str):
             raise TypeError(f"path must be str, got {type(path)}")
         np_dtype = kwargs.pop("dtype", _np_storage_dtype(data.dtype))  # h5py casts on write
+        multi, host_arr = _multiprocess_gather_for_save(data)
+        if multi:
+            if jax.process_index() == 0:
+                with h5py.File(path, mode) as handle:
+                    handle.create_dataset(
+                        dataset, shape=data.shape, dtype=np_dtype, data=host_arr, **kwargs
+                    )
+            _sync_processes("heat_tpu.io.save_hdf5")
+            return
         with h5py.File(path, mode) as handle:
             ds = handle.create_dataset(dataset, shape=data.shape, dtype=np_dtype, **kwargs)
             _write_shards(data, lambda sl, host: ds.__setitem__(sl, host))
@@ -263,6 +308,13 @@ if __NETCDF:
             raise ValueError(
                 f"{len(dims)} dimension names given for {data.ndim} dimensions"
             )
+        multi, host_arr = _multiprocess_gather_for_save(data)
+        if multi and jax.process_index() != 0:
+            # the allgather above was the collective part; only process 0
+            # opens the file (plain netCDF4 handles are not multi-writer
+            # safe — reference uses parallel=True, io.py:585)
+            _sync_processes("heat_tpu.io.save_netcdf")
+            return
         with netCDF4.Dataset(path, mode) as handle:
             for i, name in enumerate(dims):
                 if name not in handle.dimensions:
@@ -279,7 +331,12 @@ if __NETCDF:
                     and all(s == slice(None) or s is Ellipsis for s in file_slices)
                 )
             )
-            if trivial:
+            if multi:
+                target = file_slices if not trivial else tuple(
+                    slice(0, s) for s in data.shape
+                )
+                var[target] = host_arr
+            elif trivial:
                 # one hyperslab write per device shard, never gathering
                 # (the reference's rank-ordered writes, io.py:366)
                 _write_shards(data, lambda sl, host: var.__setitem__(sl, host))
@@ -291,6 +348,136 @@ if __NETCDF:
                 if data.dtype is types.bfloat16:
                     arr = np.asarray(arr, dtype=np.float32)
                 var[file_slices] = arr
+        if multi:
+            _sync_processes("heat_tpu.io.save_netcdf")
+
+
+_CSV_ANCHOR_STRIDE = 256  # one recorded line-start offset per 256 lines
+
+
+def _csv_data_start(path: str, header_lines: int) -> int:
+    """Byte offset of the first data row (after ``header_lines`` lines)."""
+    if header_lines <= 0:
+        return 0
+    off = 0
+    with open(path, "rb") as fh:
+        for _ in range(header_lines):
+            line = fh.readline()
+            if not line:
+                break
+            off += len(line)
+    return off
+
+
+def _csv_scan_range(path: str, start: int, stop: int, data_start: int, file_size: int):
+    """Scan bytes [start, stop) of the file for line starts — each host
+    touches ONLY its range (the reference's per-rank byte-range scan,
+    io.py:807-830). Returns (line_count, anchors) where ``anchors``
+    records the byte offset of every ``_CSV_ANCHOR_STRIDE``-th line this
+    range owns (a line is owned by the range containing the newline that
+    precedes it), bounding index memory at ~8 bytes per 256 lines."""
+    count = 0
+    anchors = []
+    # the very first data row has no preceding newline; a header-only /
+    # empty file (data_start == file_size) has no first row to seed
+    if start == data_start and data_start < file_size:
+        anchors.append(data_start)
+        count = 1
+    chunk_size = 1 << 22
+    with open(path, "rb") as fh:
+        fh.seek(start)
+        pos = start
+        remaining = stop - start
+        while remaining > 0:
+            buf = fh.read(min(chunk_size, remaining))
+            if not buf:
+                break
+            idx = buf.find(b"\n")
+            while idx >= 0:
+                line_start = pos + idx + 1
+                if line_start < file_size:  # trailing newline starts no row
+                    if count % _CSV_ANCHOR_STRIDE == 0:
+                        anchors.append(line_start)
+                    count += 1
+                idx = buf.find(b"\n", idx + 1)
+            pos += len(buf)
+            remaining -= len(buf)
+    return count, anchors
+
+
+def _load_csv_parallel(
+    path: str, header_lines: int, sep: str, dtype, encoding: str, device, comm
+) -> DNDarray:
+    """Multi-process split=0 CSV ingest by byte ranges (the TPU-native
+    analog of reference io.py:818-900): every host scans only its byte
+    range for line starts, the tiny stride-compressed index is
+    allgathered, and each host then reads exactly the byte spans that
+    cover its addressable devices' row blocks. No host ever holds the
+    whole file. Interior rows must be non-empty and uniform-width (the
+    reference's empty-line tolerance is a torch-side repack this path
+    trades for bounded memory)."""
+    import io as _io
+
+    from jax.experimental import multihost_utils
+
+    file_size = os.path.getsize(path)
+    data_start = _csv_data_start(path, header_lines)
+    nproc = jax.process_count()
+    p = jax.process_index()
+    span = file_size - data_start
+    start = data_start + p * span // nproc
+    stop = data_start + (p + 1) * span // nproc
+    count, anchors = _csv_scan_range(path, start, stop, data_start, file_size)
+
+    # exchange (count, n_anchors), then the padded anchor arrays
+    meta = multihost_utils.process_allgather(
+        np.array([count, len(anchors)], dtype=np.int64)
+    ).reshape(nproc, 2)
+    counts = meta[:, 0]
+    max_anchors = int(meta[:, 1].max())
+    padded = np.full(max(max_anchors, 1), -1, dtype=np.int64)
+    padded[: len(anchors)] = np.asarray(anchors, dtype=np.int64)
+    all_anchors = multihost_utils.process_allgather(padded).reshape(nproc, -1)
+    cum = np.concatenate([[0], np.cumsum(counts)])
+    n_rows = int(cum[-1])
+
+    # column count from the first data row (every host reads one line)
+    with open(path, "rb") as fh:
+        fh.seek(data_start)
+        first = fh.readline().decode(encoding)
+    n_cols = first.rstrip("\r\n").count(sep) + 1 if first.strip() else 1
+
+    def locate(row: int) -> int:
+        """Byte offset of global data row ``row``'s line start."""
+        if row >= n_rows:
+            return file_size
+        q = int(np.searchsorted(cum, row, side="right") - 1)
+        j = row - int(cum[q])
+        a = j // _CSV_ANCHOR_STRIDE
+        off = int(all_anchors[q, a])
+        skip = j - a * _CSV_ANCHOR_STRIDE
+        if skip == 0:
+            return off
+        with open(path, "rb") as fh:
+            fh.seek(off)
+            for _ in range(skip):
+                fh.readline()
+            return fh.tell()
+
+    np_dtype = _np_storage_dtype(dtype)
+
+    def read_slab(sl):
+        rstart, rstop = sl[0].start or 0, sl[0].stop
+        b0, b1 = locate(rstart), locate(rstop)
+        with open(path, "rb") as fh:
+            fh.seek(b0)
+            raw = fh.read(b1 - b0)
+        block = np.genfromtxt(
+            _io.BytesIO(raw), delimiter=sep, dtype=np_dtype, encoding=encoding
+        ).reshape(rstop - rstart, n_cols)
+        return block[(slice(None),) + tuple(sl[1:])]
+
+    return _assemble_sharded(read_slab, (n_rows, n_cols), dtype, 0, device, comm)
 
 
 def load_csv(
@@ -303,11 +490,17 @@ def load_csv(
     device=None,
     comm=None,
 ) -> DNDarray:
-    """Load a CSV file (reference: io.py:722 — byte-range splits per rank;
-    single controller reads once)."""
+    """Load a CSV file (reference: io.py:722). split=0 in a multi-process
+    world reads per-host byte ranges (see ``_load_csv_parallel``); other
+    configurations parse on the controller like the reference's
+    split=None/1 full-file passes (io.py:805, 925-946)."""
     if not isinstance(path, str):
         raise TypeError(f"path must be str, got {type(path)}")
+    if split not in (None, 0, 1):
+        raise ValueError(f"split must be in [None, 0, 1], but is {split}")
     dtype = types.canonical_heat_type(dtype)
+    if split == 0 and jax.process_count() > 1:
+        return _load_csv_parallel(path, header_lines, sep, dtype, encoding, device, comm)
     np_dtype = _np_storage_dtype(dtype)
     data = np.genfromtxt(
         path, delimiter=sep, skip_header=header_lines, dtype=np_dtype, encoding=encoding
@@ -332,15 +525,19 @@ def save_csv(
     decimals: int = -1,
     **kwargs,
 ) -> None:
-    """Save a DNDarray to CSV (reference: io.py:948)."""
+    """Save a DNDarray to CSV (reference: io.py:948). Multi-process: the
+    ``numpy()`` allgather is collective, but only process 0 writes the
+    file (single-writer safety, same policy as save_hdf5)."""
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, got {type(data)}")
     arr = data.numpy()
-    if arr.ndim == 1:
-        arr = arr.reshape(-1, 1)
-    fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
-    header = "\n".join(header_lines) if header_lines else ""
-    np.savetxt(path, arr, delimiter=sep, fmt=fmt, header=header, comments="")
+    if jax.process_count() == 1 or jax.process_index() == 0:
+        if arr.ndim == 1:
+            arr = arr.reshape(-1, 1)
+        fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
+        header = "\n".join(header_lines) if header_lines else ""
+        np.savetxt(path, arr, delimiter=sep, fmt=fmt, header=header, comments="")
+    _sync_processes("heat_tpu.io.save_csv")
 
 
 def load(path: str, *args, **kwargs) -> DNDarray:

@@ -96,6 +96,17 @@ def qr(
         raise TypeError(f"calc_q must be a bool, got {type(calc_q)}")
     if not isinstance(tiles_per_proc, (int, np.integer)) or isinstance(tiles_per_proc, bool):
         raise TypeError(f"tiles_per_proc must be an int, got {type(tiles_per_proc)}")
+    if tiles_per_proc != 1:
+        import warnings
+
+        # reference code tunes this against CPU cache blocking; here XLA
+        # owns MXU tiling — a silent no-op would surprise ported callers
+        warnings.warn(
+            "tiles_per_proc is accepted for reference-API parity but has no "
+            "effect: XLA performs its own MXU tiling (TSQR replaces tiled CAQR)",
+            UserWarning,
+            stacklevel=2,
+        )
     if not isinstance(overwrite_a, bool):
         raise TypeError(f"overwrite_a must be a bool, got {type(overwrite_a)}")
 

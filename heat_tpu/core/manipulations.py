@@ -709,11 +709,46 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
 
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
     """Unique elements (reference: manipulations.py:3202 — local unique +
-    allgather + re-unique; here an eager jnp.unique — data-dependent output
-    shape, evaluated on host sizes)."""
+    allgather of the small sets + re-unique).
+
+    Distributed flat unique is gather-free: a per-shard sorted-unique
+    compaction, one tiny count sync, and a merge over only the candidate
+    prefixes (``parallel.distributed_unique``) — the operand is never
+    all-gathered. ``axis`` mode (rows-unique) and the single-device path
+    use eager ``jnp.unique`` (data-dependent output shape)."""
     sanitize_in(a)
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)
+    comm = a.comm
+    if (
+        axis is None
+        and a.split is not None
+        and comm.is_distributed()
+        and 0 not in a.gshape  # zero-extent arrays are stored replicated
+    ):
+        from . import parallel as _parallel
+
+        arr = a if a.split == 0 else a.resplit(0)
+        phys = arr._phys
+        is_bool = phys.dtype == jnp.bool_
+        if is_bool:
+            phys = phys.astype(jnp.uint8)
+        values = _parallel.distributed_unique(
+            phys, int(arr.gshape[0]), comm.mesh, comm.axis_name
+        )
+        if is_bool:
+            values = values.astype(jnp.bool_)
+        vals = _wrap(values, 0, a, dtype=a.dtype)
+        if return_inverse:
+            # searchsorted into the small replicated unique set — binary
+            # search per element, computed shard-wise under GSPMD (the
+            # replicated u needs no collective)
+            inv_phys = jnp.searchsorted(
+                values.astype(phys.dtype), a.larray.reshape(-1)
+            ).astype(jnp.int64)
+            inv = _wrap(jnp.asarray(inv_phys), None, a)
+            return vals, inv
+        return vals
     if return_inverse:
         values, inverse = jnp.unique(a.larray, return_inverse=True, axis=axis)
     else:

@@ -402,6 +402,283 @@ def ring_pairwise(
         out = jnp.where(computed, out, out.T)
     return out
 
+# ---------------------------------------------------------------------- #
+# distributed stream compaction (bool-mask select / nonzero / unique)    #
+# ---------------------------------------------------------------------- #
+# The reference serves data-dependent-shape ops with rank-local results
+# (nonzero: indexing.py local nonzero + split-offset; unique:
+# manipulations.py:3202 local unique + allgather of the small sets; mask
+# getitem: dndarray.py:827 rank-local selection). Uneven rank-local
+# shapes don't exist under GSPMD's even-block invariant, so the TPU-native
+# schedule is: (1) a per-shard count+compact program (static shapes,
+# candidates padded to the shard extent), (2) ONE tiny host read of the
+# per-shard counts — the same world-sync the reference's Allgather of
+# local sizes performs, (3) a balanced-redistribution program that
+# all-gathers only the C = max-count candidate PREFIXES (bounded by the
+# output size, never the input) and assembles even split=0 blocks. No
+# full all-gather of the operand ever appears in the HLO.
+
+
+def _host_counts(counts: jax.Array) -> np.ndarray:
+    """Read the tiny per-shard count vector to the host — the one world
+    sync these schedules need (the analog of the reference's size
+    Allgather). Cross-process worlds cannot ``device_get`` a globally
+    sharded array; the allgather of a (p,) int vector is negligible."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(counts, tiled=True))
+    return np.asarray(jax.device_get(counts))
+
+
+@functools.lru_cache(maxsize=64)
+def _mask_compact_program(
+    mesh: Mesh, axis_name: str, blk_shape, rows: bool, jdtype: str
+):
+    """Per-shard count + fixed-capacity compaction. ``blk_shape`` is the
+    local block; ``rows=True`` selects axis-0 rows by a 1-D mask block,
+    else flattened elements by a same-shape mask block. The mask must
+    already be False in pad slots. Outputs: candidates padded to the
+    block extent (selected entries front-packed, garbage beyond the
+    count) and the per-shard count."""
+    L = blk_shape[0] if rows else int(np.prod(blk_shape))
+    spec_x = P(*(axis_name if i == 0 else None for i in range(len(blk_shape))))
+    spec_m = P(axis_name) if rows else spec_x
+    out_trailing = blk_shape[1:] if rows else ()
+    spec_c = P(*((axis_name,) + (None,) * len(out_trailing)))
+
+    def body(x_blk, m_blk):
+        if rows:
+            flat_m = m_blk
+            data = x_blk
+        else:
+            flat_m = m_blk.reshape(-1)
+            data = x_blk.reshape(-1)
+        c = jnp.sum(flat_m.astype(jnp.int32))
+        idx = jnp.nonzero(flat_m, size=L, fill_value=L)[0]
+        pad_row = jnp.zeros((1,) + data.shape[1:], dtype=data.dtype)
+        cand = jnp.concatenate([data, pad_row])[idx]
+        return cand, c.reshape(1)
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(spec_x, spec_m),
+        out_specs=(spec_c, P(axis_name)), check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _balanced_gather_program(
+    mesh: Mesh, axis_name: str, cand_blk_shape, cap: int, b_out: int, jdtype: str
+):
+    """Assemble even split=0 blocks of the compacted stream: all-gather
+    the first ``cap`` candidates of every shard (cap = max per-shard
+    count ≤ output size) plus the count vector, compute exclusive
+    prefixes, and let each output shard take its ``b_out`` rows. The
+    total count arrives as a RUNTIME scalar — only (cap, b_out) shape
+    the program, so the p distinct totals per block size share one
+    compilation."""
+    trailing = cand_blk_shape[1:]
+    spec_c = P(*((axis_name,) + (None,) * len(trailing)))
+
+    def body(cand_blk, cnt_blk, n_total):
+        allc = lax.all_gather(cand_blk[:cap], axis_name)          # (p, cap, ...)
+        counts = lax.all_gather(cnt_blk, axis_name).reshape(-1)   # (p,)
+        cum = jnp.cumsum(counts)
+        r = lax.axis_index(axis_name)
+        g = r * b_out + jax.lax.broadcasted_iota(jnp.int32, (b_out,), 0)
+        q = jnp.searchsorted(cum, g, side="right").astype(jnp.int32)
+        qc = jnp.minimum(q, counts.shape[0] - 1)
+        li = g - (cum[qc] - counts[qc])
+        flat = allc.reshape((-1,) + trailing)
+        rows_out = flat[jnp.clip(qc * cap + li, 0, flat.shape[0] - 1)]
+        keep = (g < n_total).reshape((-1,) + (1,) * len(trailing))
+        return jnp.where(keep, rows_out, jnp.zeros_like(rows_out))
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(spec_c, P(axis_name), P()), out_specs=spec_c,
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _compact_gather(cand, counts, mesh, axis_name, empty_trailing):
+    """Shared postlude of the compaction schedules: read the tiny count
+    vector (the one host sync), size the capacity/output block, and run
+    the balanced gather. Returns ``(result_phys, n_total)``."""
+    p = mesh.devices.size
+    counts_host = _host_counts(counts)
+    n_total = int(counts_host.sum())
+    if n_total == 0:
+        return jnp.zeros((0,) + tuple(empty_trailing), dtype=cand.dtype), 0
+    cap = int(counts_host.max())
+    b_out = -(-n_total // p)
+    gather = _balanced_gather_program(
+        mesh, axis_name,
+        tuple(s // p if i == 0 else s for i, s in enumerate(cand.shape)),
+        cap, b_out, np.dtype(cand.dtype).name,
+    )
+    return gather(cand, counts, jnp.int32(n_total)), n_total
+
+
+def compact_select(
+    data_phys: jax.Array,
+    mask_phys: jax.Array,
+    mesh: Mesh,
+    axis_name: str,
+    rows: bool,
+):
+    """Gather-free selection of masked elements (or axis-0 rows) from a
+    split=0 physical array into an even split=0 physical result.
+
+    Returns ``(result_phys, n_selected)`` — the count read-back is the
+    one small host sync (the analog of the reference's size Allgather).
+    """
+    p = mesh.devices.size
+    prog = _mask_compact_program(
+        mesh, axis_name,
+        tuple(s // p if i == 0 else s for i, s in enumerate(data_phys.shape)),
+        rows, np.dtype(data_phys.dtype).name,
+    )
+    cand, counts = prog(data_phys, mask_phys)
+    return _compact_gather(
+        cand, counts, mesh, axis_name,
+        tuple(data_phys.shape[1:]) if rows else (),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _nonzero_compact_program(mesh: Mesh, axis_name: str, blk_shape, n_split: int, jdtype: str):
+    """Per-shard nonzero: count + front-packed GLOBAL coordinates
+    (reference indexing.py nonzero returns rank-local results shifted by
+    the split offset — same coordinates, even blocks here)."""
+    L = int(np.prod(blk_shape))
+    b0 = blk_shape[0]
+    ndim = len(blk_shape)
+    spec = P(*(axis_name if i == 0 else None for i in range(ndim)))
+
+    def body(x_blk):
+        r = lax.axis_index(axis_name)
+        valid0 = (r * b0 + jax.lax.broadcasted_iota(jnp.int32, (b0,), 0)) < n_split
+        m = (x_blk != 0) & jnp.broadcast_to(
+            valid0.reshape((b0,) + (1,) * (ndim - 1)), blk_shape
+        )
+        flat = m.reshape(-1)
+        c = jnp.sum(flat.astype(jnp.int32))
+        idx = jnp.nonzero(flat, size=L, fill_value=0)[0]
+        coords = list(jnp.unravel_index(idx, blk_shape))
+        coords[0] = coords[0] + (r * b0).astype(coords[0].dtype)
+        cand = jnp.stack(coords, axis=1).astype(jnp.int64)  # (L, ndim)
+        return cand, c.reshape(1)
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(spec,),
+        out_specs=(P(axis_name, None), P(axis_name)), check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def distributed_nonzero(phys: jax.Array, n_split: int, mesh: Mesh, axis_name: str):
+    """Gather-free nonzero of a split=0 physical array → even split=0
+    physical (nnz, ndim) int64 coordinates plus the count (one small host
+    sync for the per-shard counts)."""
+    p = mesh.devices.size
+    blk = tuple(s // p if i == 0 else s for i, s in enumerate(phys.shape))
+    cand, counts = _nonzero_compact_program(
+        mesh, axis_name, blk, n_split, np.dtype(phys.dtype).name
+    )(phys)
+    return _compact_gather(cand, counts, mesh, axis_name, (phys.ndim,))
+
+
+def _sorted_dedup(flat, valid):
+    """Shared dedup core of the unique schedules: lexicographic
+    ``lax.sort`` over (invalid-flag, value) sinks every invalid slot past
+    the valid ones, then duplicate-marking compacts the survivors to the
+    front. NaNs sort last among valid entries and collapse to ONE (the
+    ``differs`` mask treats NaN==NaN as equal), matching ``np.unique``'s
+    equal_nan semantics (numpy ≥ 1.21).
+
+    Returns (compacted values — garbage past the count, count)."""
+    L = flat.shape[0]
+    invalid = (~valid).astype(jnp.int8)
+    inv_s, s = lax.sort((invalid, flat), num_keys=2, is_stable=True)
+    first = jax.lax.broadcasted_iota(jnp.int32, (L,), 0) == 0
+    prev = jnp.concatenate([s[:1], s[:-1]])
+    differs = s != prev
+    if jnp.issubdtype(s.dtype, jnp.floating):
+        differs = differs & ~(jnp.isnan(s) & jnp.isnan(prev))
+    keep = (inv_s == 0) & (first | differs)
+    c = jnp.sum(keep.astype(jnp.int32))
+    idx = jnp.nonzero(keep, size=L, fill_value=L)[0]
+    return jnp.concatenate([s, s[:1]])[idx], c
+
+
+@functools.lru_cache(maxsize=64)
+def _local_unique_program(mesh: Mesh, axis_name: str, blk_shape, n_split: int, jdtype: str):
+    """Per-shard sorted unique with fixed capacity (see ``_sorted_dedup``
+    for the dedup semantics)."""
+    b0 = blk_shape[0]
+    spec = P(*(axis_name if i == 0 else None for i in range(len(blk_shape))))
+
+    def body(x_blk):
+        r = lax.axis_index(axis_name)
+        valid0 = (r * b0 + jax.lax.broadcasted_iota(jnp.int32, (b0,), 0)) < n_split
+        valid = jnp.broadcast_to(
+            valid0.reshape((b0,) + (1,) * (len(blk_shape) - 1)), blk_shape
+        ).reshape(-1)
+        cand, c = _sorted_dedup(x_blk.reshape(-1), valid)
+        return cand, c.reshape(1)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=(P(axis_name), P(axis_name)), check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _unique_merge_program(mesh: Mesh, axis_name: str, p: int, cap: int, jdtype: str):
+    """Merge the per-shard unique candidate prefixes: all-gather the tiny
+    (p·cap) set, re-sort with validity keys, deduplicate — replicated
+    output (the reference Bcasts its merged set the same way)."""
+
+    def body(cand_blk, cnt_blk):
+        allc = lax.all_gather(cand_blk[:cap], axis_name).reshape(-1)   # (p*cap,)
+        counts = lax.all_gather(cnt_blk, axis_name).reshape(-1)
+        pos = jax.lax.broadcasted_iota(jnp.int32, (p * cap,), 0)
+        valid = (pos % cap) < counts[pos // cap]
+        return _sorted_dedup(allc, valid)
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(P(axis_name), P(axis_name)),
+        out_specs=(P(), P()), check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def distributed_unique(
+    phys: jax.Array, n_split: int, mesh: Mesh, axis_name: str
+):
+    """Sorted unique of a split=0 physical array without gathering the
+    operand: local sorted-unique per shard, then a merge over only the
+    candidate prefixes (reference manipulations.py:3202's
+    local-unique + Allgather + re-unique, with static shapes).
+
+    Returns the merged unique values as a replicated jax array (sliced
+    to the true count — one small host sync for the two counts)."""
+    p = mesh.devices.size
+    blk = tuple(s // p if i == 0 else s for i, s in enumerate(phys.shape))
+    cand, counts = _local_unique_program(
+        mesh, axis_name, blk, n_split, np.dtype(phys.dtype).name
+    )(phys)
+    counts_host = _host_counts(counts)
+    cap = max(int(counts_host.max()), 1)
+    merged, total = _unique_merge_program(
+        mesh, axis_name, p, cap, np.dtype(phys.dtype).name
+    )(cand, counts)
+    return merged[: int(jax.device_get(total))]
+
+
+__all__ += ["compact_select", "distributed_unique", "distributed_nonzero"]
+
+
 from .communication import register_mesh_cache
 
 # entries bake mesh geometry: cleared when init_distributed rebuilds the world
@@ -410,3 +687,8 @@ register_mesh_cache(_topk_program)
 register_mesh_cache(_ring_program)
 register_mesh_cache(_oddeven_sort_program)
 register_mesh_cache(_oddeven_sort_values_program)
+register_mesh_cache(_mask_compact_program)
+register_mesh_cache(_balanced_gather_program)
+register_mesh_cache(_nonzero_compact_program)
+register_mesh_cache(_local_unique_program)
+register_mesh_cache(_unique_merge_program)

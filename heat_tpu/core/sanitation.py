@@ -148,3 +148,19 @@ def sanitize_infinity(x):
     if types.heat_type_is_inexact(dtype):
         return float(types.finfo(dtype).max)
     return int(types.iinfo(dtype).max)
+
+
+def assert_evenly_sharded(x, label: str = "") -> None:
+    """Scale-safety invariant: every local device holds exactly phys/p
+    bytes of ``x`` — the array is truly distributed, never replicated or
+    gathered to one device. Shared by the driver dryrun and the test
+    suite so both enforce the same invariant."""
+    comm = x.comm
+    shards = x._phys.addressable_shards
+    local = sum(1 for d in comm.devices if d.process_index == __import__("jax").process_index())
+    assert len(shards) == local, f"{label}: {len(shards)} shards for {local} local devices"
+    expect = x._phys.nbytes // comm.size
+    for s in shards:
+        assert s.data.nbytes == expect, (
+            f"{label}: device {s.device} holds {s.data.nbytes} bytes, expected {expect}"
+        )
