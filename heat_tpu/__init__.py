@@ -14,11 +14,12 @@ Usage mirrors the reference::
     print(ht.sum(x))
 """
 
-import jax as _jax
-
-# float64/int64 parity with the reference's NumPy semantics; defaults in
-# factories remain float32/int32, so TPU hot paths stay in fast dtypes.
-_jax.config.update("jax_enable_x64", True)
+# 64-bit dtype support is a PLATFORM POLICY, not an import side effect:
+# CPU/GPU worlds enable JAX's x64 mode on first backend use (full
+# float64/int64 parity with the reference); TPU worlds keep it off and
+# degrade 64-bit dtype requests to 32-bit (the chip has no 64-bit
+# arithmetic). Override explicitly with ``ht.use_x64(True/False)``.
+# See core/devices.py:_apply_x64_policy.
 
 from .core import *
 from .core.linalg import *

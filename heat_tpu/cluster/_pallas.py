@@ -51,7 +51,12 @@ def pallas_available() -> bool:
     """True when the backend can execute the compiled kernel (gate for the
     opt-in path; auto-selection stays on the XLA-fused formulation, which
     measures at the bandwidth bound — see module docstring)."""
-    return pltpu is not None and jax.default_backend() == "tpu" and jax.device_count() == 1
+    return (
+        pltpu is not None
+        and jax.default_backend() == "tpu"
+        and jax.device_count() == 1
+        and not jax.config.jax_enable_x64  # Mosaic rejects x64-mode traces
+    )
 
 
 def _round_up(x: int, m: int) -> int:
@@ -117,12 +122,12 @@ def fused_assign_program(n: int, d: int, k: int, jdtype: str, interpret: bool = 
     )
 
     def run(x, centers):
-        # trace with x64 disabled: Mosaic rejects the 64-bit scalar types
-        # x64 mode leaks into the grid/index machinery (operands are ≤f32)
-        with jax.enable_x64(False):
-            if npad != n:
-                x = jnp.pad(x, ((0, npad - n), (0, 0)))
-            acc = call(x.astype(jnp.dtype(jdtype)), centers.astype(jnp.dtype(jdtype)))
-            return acc[:, :d], acc[:, d], jnp.sum(acc[:, d + 1])
+        # x64 is off on TPU by platform policy, so Mosaic's grid/index
+        # machinery traces with 32-bit scalars; the forced-x64
+        # configuration is gated out in pallas_available
+        if npad != n:
+            x = jnp.pad(x, ((0, npad - n), (0, 0)))
+        acc = call(x.astype(jnp.dtype(jdtype)), centers.astype(jnp.dtype(jdtype)))
+        return acc[:, :d], acc[:, d], jnp.sum(acc[:, d + 1])
 
     return jax.jit(run)

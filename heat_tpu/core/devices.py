@@ -15,7 +15,7 @@ import jax
 
 from typing import Any, Optional, Union
 
-__all__ = ["Device", "cpu", "get_device", "sanitize_device", "use_device"]
+__all__ = ["Device", "cpu", "get_device", "sanitize_device", "use_device", "use_x64"]
 
 
 class Device:
@@ -90,9 +90,80 @@ _detected = False
 __default_device: Optional[Device] = None
 
 
+# 64-bit (x64) policy. JAX's x64 flag is global and poisons TPU traces
+# (the TPU compiler has no 64-bit arithmetic and SIGABRTs on some x64-mode
+# lowerings, see linalg/_lapack.py), so the framework decides it PER
+# PLATFORM at first backend use instead of blanket-enabling it at import:
+# CPU/GPU get full float64/int64 parity with the reference; TPU runs with
+# x64 off and 64-bit dtype requests degrade to 32-bit (types.degrade64).
+# ``use_x64`` overrides explicitly.
+_x64_choice: "Optional[bool]" = None
+
+
+def use_x64(flag: "Optional[bool]" = None) -> bool:
+    """Set (or, with ``flag=None``, query) the 64-bit dtype mode.
+
+    ``use_x64(True)`` enables real float64/int64 arrays everywhere —
+    including TPU, where 64-bit arithmetic is emulated and some linalg
+    lowerings are fragile (safe_svd guards the known compiler bug).
+    ``use_x64(False)`` degrades every 64-bit dtype request to its 32-bit
+    counterpart (the TPU default). Returns the active mode.
+
+    A pure query resolves the platform policy first, which initializes
+    the backend — in a multi-host program, call ``init_distributed``
+    BEFORE querying (the same ordering every backend-touching call has).
+    An explicit set is recorded without touching the backend and
+    overrides the platform policy whenever it is (or was) decided."""
+    global _x64_choice
+    if flag is not None:
+        _x64_choice = bool(flag)
+        _set_x64(_x64_choice)
+    else:
+        _ensure_detected()  # an undecided policy would report JAX's default
+    return bool(jax.config.jax_enable_x64)
+
+
+_TRUNCATION_FILTER_ON = False
+
+
+def _set_x64(enable: bool) -> None:
+    import warnings
+
+    from . import types as _types
+
+    global _TRUNCATION_FILTER_ON
+    jax.config.update("jax_enable_x64", bool(enable))
+    _types._DEGRADE_64 = not enable
+    if not enable and not _TRUNCATION_FILTER_ON:
+        # the 64->32 degradation is a documented platform policy; JAX's
+        # per-op truncation warnings would fire on every internal int64
+        # index cast. Installed once; removed again on re-enable so user
+        # code keeps its genuine-truncation warnings in x64 mode.
+        warnings.filterwarnings(
+            "ignore", message=".*will be truncated to dtype.*", category=UserWarning
+        )
+        _TRUNCATION_FILTER_ON = True
+    elif enable and _TRUNCATION_FILTER_ON:
+        warnings.filters[:] = [
+            f for f in warnings.filters
+            if not (
+                f[0] == "ignore"
+                and f[1] is not None
+                and getattr(f[1], "pattern", "") == ".*will be truncated to dtype.*"
+            )
+        ]
+        _TRUNCATION_FILTER_ON = False
+
+
+def _apply_x64_policy(backend: str) -> None:
+    if _x64_choice is None:
+        _set_x64(backend in ("cpu", "gpu"))
+
+
 def _ensure_detected() -> None:
     """Probe accelerator platforms and pick the default device, once, on
-    first use (NOT at import — see note on ``_registry``)."""
+    first use (NOT at import — see note on ``_registry``). Also decides
+    the platform's x64 policy (see ``use_x64``)."""
     global _detected, __default_device
     if _detected:
         return
@@ -112,18 +183,21 @@ def _ensure_detected() -> None:
                 _registry["tpu"] = Device("tpu", 0, _default[0].platform)
         except RuntimeError:
             pass
+    # default device follows the default JAX backend (TPU when present)
+    try:
+        _backend = jax.default_backend()
+    except RuntimeError:
+        _backend = "cpu"
     if __default_device is None:
-        # default device follows the default JAX backend (TPU when present)
-        try:
-            _backend = jax.default_backend()
-        except RuntimeError:
-            _backend = "cpu"
         if _backend == "cpu":
             __default_device = cpu
         elif _backend == "gpu":
             __default_device = _registry.get("gpu", cpu)
         else:
             __default_device = _registry.get("tpu", _registry.get(_backend, cpu))
+    # the x64 policy is about the BACKEND, not the chosen default device —
+    # it must apply even when use_device() pre-set the default
+    _apply_x64_policy("cpu" if _backend == "cpu" else ("gpu" if _backend == "gpu" else "tpu"))
 
 
 def __getattr__(name: str):

@@ -110,7 +110,7 @@ class DNDarray:
     ):
         self.__array = array
         self.__gshape = tuple(int(s) for s in gshape)
-        self.__dtype = dtype
+        self.__dtype = types.degrade64(dtype)
         self.__split = split if split is None else int(split) % max(len(gshape), 1)
         self.__device = device
         self.__comm = comm

@@ -4,10 +4,15 @@ The current TPU compiler SIGABRTs (XLA ``TransposeFolding``:
 ``Check failed: buffer != nullptr``) when lowering ``jnp.linalg.svd``
 traced in x64 mode — the int64 index iotas of the QDWH/Jacobi expansion
 trigger the bug; the identical f32 computation traced with x64 disabled
-compiles fine. heat_tpu enables x64 globally for float64/int64 API parity,
-so every SVD callsite goes through ``svd_x32_scope``: a scoped
-``jax.enable_x64(False)`` when the operand is 32-bit (the TPU-relevant
-case). 64-bit operands keep x64 (they run on CPU, whose compiler is fine).
+compiles fine.
+
+Since round 3 x64 is OFF on TPU by platform policy
+(devices._apply_x64_policy), so the default configuration never hits the
+bug and ``svd_x32_scope`` is a no-op. The scope stays ONLY for the
+explicitly-forced ``ht.use_x64(True)``-on-TPU configuration, where a
+32-bit SVD operand would otherwise be traced in x64 mode and crash the
+compiler. CPU worlds (x64 on) lower the same traces fine and are left
+untouched.
 """
 
 from __future__ import annotations
@@ -21,8 +26,14 @@ __all__ = ["safe_svd", "safe_svdvals", "svd_x32_scope"]
 
 
 def svd_x32_scope(dtype):
-    """Context manager disabling x64 tracing for 32-bit SVD lowering."""
-    if jnp.dtype(dtype).itemsize <= 4:
+    """Context manager disabling x64 tracing for 32-bit SVD lowering on
+    TPU — active ONLY in the forced x64-on-TPU configuration (see module
+    docstring); a no-op everywhere else."""
+    if (
+        jnp.dtype(dtype).itemsize <= 4
+        and jax.config.jax_enable_x64
+        and jax.default_backend() == "tpu"
+    ):
         return jax.enable_x64(False)
     return contextlib.nullcontext()
 

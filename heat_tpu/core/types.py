@@ -290,27 +290,48 @@ __name_mappings = {
 }
 
 
+# 64-bit degradation policy: on platforms without 64-bit arithmetic (TPU —
+# JAX's x64 mode stays off there by default, see devices._apply_x64_policy)
+# requesting a 64-bit dtype yields its 32-bit counterpart, HONESTLY: both
+# the device buffer and the array's dtype metadata degrade together, the
+# way bf16-era accelerator stacks treat f64. Flipped by the platform
+# policy / ht.use_x64(); never active in x64 mode.
+_DEGRADE_64 = False
+
+
+_DEGRADE_MAP = {float64: float32, int64: int32, complex128: complex64}
+
+
+def degrade64(t: Type["datatype"]) -> Type["datatype"]:
+    """Apply the 64→32-bit platform degradation to a heat type (no-op in
+    x64 mode)."""
+    if _DEGRADE_64:
+        return _DEGRADE_MAP.get(t, t)
+    return t
+
+
 def canonical_heat_type(a_type: Union[str, Type[datatype], Any]) -> Type[datatype]:
     """Canonicalize a builtin Python type, type string, numpy/jax dtype or
     heat type into the canonical heat_tpu type (reference: types.py:494).
+    Applies the 64→32-bit platform degradation (see ``degrade64``).
     """
     # already a heat type
     try:
         if issubclass(a_type, datatype):
-            return a_type
+            return degrade64(a_type)
     except TypeError:
         pass
 
     mapped = __type_mappings.get(a_type)
     if mapped is not None:
-        return mapped
+        return degrade64(mapped)
 
     # numpy / jax dtype objects and their string names
     try:
         name = np.dtype(a_type).name
         mapped = __name_mappings.get(name)
         if mapped is not None:
-            return mapped
+            return degrade64(mapped)
     except TypeError:
         pass
 

@@ -200,15 +200,12 @@ def _pallas_attention_program(q_shape, kv_shape, causal: bool, scale: float, jdt
     )
 
     def run(qa, ka, va):
-        # the kernel's block-index maps mix int32 iotas with Python ints;
-        # tracing them in the framework's global x64 mode produces
-        # int64/int32 lax.select mismatches — trace with x64 off (the
-        # f32/bf16 operands are unaffected; same scoped workaround as
-        # linalg._lapack)
-        with jax.enable_x64(False):
-            return flash_attention(
-                qa, ka, va, causal=causal, sm_scale=float(scale), block_sizes=bs
-            )
+        # x64 is off on TPU by platform policy (devices._apply_x64_policy),
+        # so the kernel's int32 block-index maps trace cleanly; the
+        # forced-x64 configuration is gated out in _pallas_attention
+        return flash_attention(
+            qa, ka, va, causal=causal, sm_scale=float(scale), block_sizes=bs
+        )
 
     try:
         jt = jnp.dtype(jdtype)
@@ -249,12 +246,15 @@ def _pallas_attention(qa, ka, va, causal: bool, scale: float):
     XLA program is the fallback and the numerical oracle."""
     if jax.default_backend() != "tpu":
         return None
+    if jax.config.jax_enable_x64:
+        # explicitly-forced x64 on TPU: the kernel's block-index maps mix
+        # int32 iotas with Python ints and mis-trace in x64 mode — the
+        # blocked XLA program serves this configuration
+        return None
     if any(isinstance(t, jax.core.Tracer) for t in (qa, ka, va)):
         # inside a user jit/grad trace: only the blocked program is
         # guaranteed differentiable and compilable — the flash kernel's
-        # custom-vjp backward would be traced under the framework's global
-        # x64 mode (which its block-index maps cannot handle) and its
-        # dkv/dq kernels are never AOT-probed
+        # dkv/dq backward kernels are never AOT-probed here
         return None
     if not _pallas_attention_fits(qa.shape, ka.shape, va.shape, qa.dtype):
         return None

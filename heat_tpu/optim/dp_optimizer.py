@@ -228,7 +228,11 @@ class DASO:
     """
 
     def __init__(self, local_optimizer, model, n_nodes: Optional[int] = None,
-                 global_skip: int = 4, compression: bool = True, loss=None):
+                 global_skip: int = 4, compression: bool = True, loss=None,
+                 total_epochs: Optional[int] = None, warmup_epochs: int = 4,
+                 cooldown_epochs: int = 4, stability_level: float = 0.05,
+                 max_global_skips: int = 8, skip_reduction_factor: int = 2,
+                 local_skip_factor: int = 4):
         if not isinstance(local_optimizer, LocalOptimizer):
             raise TypeError(
                 f"local_optimizer must be a heat_tpu.optim optimizer, got {type(local_optimizer)}"
@@ -262,9 +266,25 @@ class DASO:
         self._iter = 0
         self._base_key = jax.random.PRNGKey(0)
         self._step_cache = {}
-        # epoch_loss_logic state (reference :354)
-        self._last_loss = None
-        self._stable_epochs = 0
+        # epoch_loss_logic state (reference :354-470): the widening/
+        # collapsing skip schedule with its stability detector
+        from .utils import DetectMetricPlateau
+
+        self.total_epochs = total_epochs
+        self.warmup_epochs = int(warmup_epochs)
+        self.cooldown_epochs = int(cooldown_epochs)
+        self.max_gs = int(max_global_skips)
+        self.skip_reduction_factor = int(skip_reduction_factor)
+        self.local_skip_factor = int(local_skip_factor)
+        self.stability = DetectMetricPlateau(patience=2, threshold=float(stability_level))
+        self.epoch = 0
+        # local_skip / batches_to_wait are schedule STATE kept for policy
+        # parity: the two-level mesh averages within a node in-program
+        # every batch (a fused psum over ICI — effectively free, unlike
+        # the reference's NCCL hop, so skipping it buys nothing), and a
+        # synchronous collective has no recv-delay to wait batches for.
+        self.local_skip = 1
+        self.batches_to_wait = 1
         # keep the wrapped model's eval path current: forwards read the
         # node-averaged parameters lazily (the reference mutates the torch
         # model in place every step, so eval there is always current)
@@ -397,18 +417,73 @@ class DASO:
             self.model.params,
         )
 
-    def epoch_loss_logic(self, loss) -> None:
-        """Adapt the global-skip count from the epoch loss (reference
-        :354-470: widens skips while the loss improves, collapses them on
-        plateau). Simplified to the policy core: improving epochs grow
-        ``global_skip`` up to 8; a plateau halves it (min 1)."""
-        loss = float(loss) if not isinstance(loss, float) else loss
-        if self._last_loss is None or loss < self._last_loss * 0.995:
-            self._stable_epochs = 0
-            self.global_skip = min(self.global_skip * 2, 8)
-        else:
-            self._stable_epochs += 1
-            if self._stable_epochs >= 2:
-                self.global_skip = max(self.global_skip // 2, 1)
-                self._stable_epochs = 0
-        self._last_loss = loss if self._last_loss is None else min(loss, self._last_loss)
+    def epoch_loss_logic(self, loss, loss_globally_averaged: bool = True) -> None:
+        """Adapt the sync schedule from the end-of-epoch loss — the
+        reference's policy verbatim (dp_optimizer.py:354-470):
+
+        * warmup epochs: every skip forced to 0 (full sync each batch);
+        * end of warmup: ``global_skip=4, local_skip=1, batches_to_wait=1``;
+        * cooldown (last ``cooldown_epochs`` of ``total_epochs``): skips 0;
+        * plateau detected (``DetectMetricPlateau``, patience 2) while
+          ``global_skip > 1``: divide skips by ``skip_reduction_factor``
+          and decrement ``batches_to_wait`` (sync more often to escape),
+          clamping live skips to ≥ 1;
+        * plateau detected at ``global_skip == 1``: widen back to
+          ``max_global_skips`` (and ``max_gs // local_skip_factor`` local
+          skips / wait batches).
+
+        Call once per epoch with the training loss; the epoch counter
+        advances here (the reference advances it on the last batch of its
+        DataLoader, which this framework does not see). The loss under a
+        single controller is already the global average (``step`` psums
+        it), so ``loss_globally_averaged`` defaults True; pass False for a
+        per-host value (e.g. a locally computed eval loss) and it is
+        averaged across processes first — every host must then make the
+        SAME schedule decision or their compiled sync programs diverge
+        (the reference's Allreduce at :372 exists for the same reason).
+        """
+        avg_loss = float(loss)
+        if not loss_globally_averaged and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            all_losses = multihost_utils.process_allgather(
+                jnp.asarray(avg_loss, dtype=jnp.float32)
+            )
+            avg_loss = float(jnp.mean(all_losses))
+        self.epoch += 1
+        epoch = self.epoch - 1  # the epoch this loss belongs to, 0-based
+
+        if epoch < self.warmup_epochs:
+            self.global_skip = 0
+            self.local_skip = 0
+            self.batches_to_wait = 0
+            return
+        if epoch == self.warmup_epochs:
+            self.global_skip = 4
+            self.local_skip = 1
+            self.batches_to_wait = 1
+        if (
+            self.total_epochs is not None
+            and epoch >= self.total_epochs - self.cooldown_epochs
+        ):
+            self.global_skip = 0
+            self.local_skip = 0
+            self.batches_to_wait = 0
+            return
+
+        stable = self.stability.test_if_improving(avg_loss)
+        if stable and self.global_skip > 1:
+            # collapse: sync more often while the loss is on a plateau
+            self.global_skip //= self.skip_reduction_factor
+            self.local_skip //= self.skip_reduction_factor
+            self.batches_to_wait -= 1
+            if self.global_skip > 0:
+                if self.batches_to_wait == 0:
+                    self.batches_to_wait = 1
+                if self.local_skip == 0:
+                    self.local_skip = 1
+        elif stable and self.global_skip == 1:
+            # bottomed out: widen back to the maximum
+            self.global_skip = self.max_gs
+            self.local_skip = self.max_gs // self.local_skip_factor
+            self.batches_to_wait = self.max_gs // self.local_skip_factor

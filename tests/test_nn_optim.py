@@ -188,15 +188,66 @@ class TestDASO:
         sched.step()
         assert abs(daso.lr - 0.1) < 1e-8
 
-    def test_epoch_loss_logic(self):
+    def test_epoch_loss_logic_matches_reference_policy(self):
+        """The schedule must take the reference's decisions verbatim on a
+        scripted loss sequence (reference dp_optimizer.py:354-470):
+        warmup zeros → post-warmup (4,1,1) → plateaus collapse the skips
+        by the reduction factor → bottoming out at gs=1 widens back to
+        max_gs → cooldown zeros."""
         dp = htnn.DataParallel(_mlp(), key=0)
-        daso = htoptim.DASO(htoptim.SGD(lr=0.01), dp, n_nodes=2, global_skip=2)
-        daso.epoch_loss_logic(1.0)
-        daso.epoch_loss_logic(0.5)   # improving → skips grow
-        assert daso.global_skip == 8
-        daso.epoch_loss_logic(0.5)
-        daso.epoch_loss_logic(0.5)   # plateau → halve
-        assert daso.global_skip == 4
+        daso = htoptim.DASO(
+            htoptim.SGD(lr=0.01), dp, n_nodes=2,
+            total_epochs=20, warmup_epochs=2, cooldown_epochs=2,
+            stability_level=0.05, max_global_skips=8,
+        )
+        # hand-simulated reference trace: (loss, gs, ls, btw) AFTER the call
+        flat = 0.8  # < 5% change → counts as a bad epoch
+        trace = [
+            (1.0, 0, 0, 0),   # warmup epoch 0
+            (0.9, 0, 0, 0),   # warmup epoch 1
+            (flat, 4, 1, 1),  # end of warmup: (4,1,1); best=0.8, improving
+            (flat, 4, 1, 1),  # bad 1
+            (flat, 4, 1, 1),  # bad 2 (patience)
+            (flat, 2, 1, 1),  # bad 3 > patience → plateau: gs 4→2, clamps
+            (flat, 2, 1, 1),  # counter reset after detection: bad 1
+            (flat, 2, 1, 1),  # bad 2
+            (flat, 1, 1, 1),  # plateau → gs 2→1
+            (flat, 1, 1, 1),
+            (flat, 1, 1, 1),
+            (flat, 8, 2, 2),  # plateau at gs=1 → widen to max_gs
+            (0.2, 8, 2, 2),   # real improvement: counter resets, no change
+            (flat, 8, 2, 2),  # bad 1 (vs best 0.2)
+            (flat, 8, 2, 2),  # bad 2
+            (flat, 4, 1, 1),  # plateau → gs 8→4, ls 2→1, btw 2→1
+            (flat, 4, 1, 1),
+            (flat, 4, 1, 1),
+            (flat, 0, 0, 0),  # epoch 18 ≥ total-cooldown → cooldown zeros
+            (flat, 0, 0, 0),  # epoch 19
+        ]
+        for i, (loss, gs, ls, btw) in enumerate(trace):
+            daso.epoch_loss_logic(loss)
+            assert (daso.global_skip, daso.local_skip, daso.batches_to_wait) == (
+                gs, ls, btw
+            ), f"epoch {i}: got {(daso.global_skip, daso.local_skip, daso.batches_to_wait)}"
+
+    def test_daso_converges_through_schedule(self):
+        """End-to-end: training drives the schedule through warmup and
+        adaptation while the loss still decreases."""
+        x_np, y_np = _toy_problem(n=256, seed=11)
+        x = ht.array(x_np, split=0)
+        y = ht.array(y_np, split=0)
+        dp = htnn.DataParallel(_mlp(), key=3)
+        daso = htoptim.DASO(htoptim.SGD(lr=0.05), dp, n_nodes=2,
+                            total_epochs=8, warmup_epochs=1, cooldown_epochs=1)
+        epoch_losses = []
+        for _ in range(8):
+            losses = [float(daso.step(x, y)) for _ in range(4)]
+            epoch_losses.append(losses[-1])
+            daso.epoch_loss_logic(epoch_losses[-1])
+        assert daso.epoch == 8
+        assert epoch_losses[-1] < epoch_losses[0], epoch_losses
+        # cooldown reached: full sync restored
+        assert daso.global_skip == 0
 
 
 class TestSchedulersAndUtils:
