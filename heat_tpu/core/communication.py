@@ -110,7 +110,22 @@ class MeshCommunication(Communication):
             self._mesh = Mesh(np.array(self._devices_), (axis_name,))
 
     def _resolve_devices(self) -> list:
-        return _platform_devices(None)
+        # topology-aware order: group devices by (slice, host) so that the
+        # 1-D mesh axis places same-slice neighbors adjacently — ring
+        # collectives (ppermute halo/sort/attention schedules) then take
+        # p−2 ICI hops and cross DCN only at slice boundaries, instead of
+        # hopping DCN on every step of an arbitrary interleaving. TPU pods
+        # expose ``slice_index`` on multi-slice deployments; single-slice
+        # and CPU worlds sort to their existing order.
+        devs = _platform_devices(None)
+        return sorted(
+            devs,
+            key=lambda d: (
+                getattr(d, "slice_index", 0) or 0,
+                d.process_index,
+                d.id,
+            ),
+        )
 
     def _ensure(self) -> None:
         if self._devices_ is None:

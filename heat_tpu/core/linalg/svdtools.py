@@ -169,7 +169,18 @@ def _sketched_uds_both(a_blk, keep: int, sketch_l: int, want: str = "left"):
     m, n = a_blk.shape
     key = jax.random.key(0x5BD)  # deterministic, like the reference's SVD
     g = jax.random.normal(key, (sketch_l, m), dtype=a_blk.dtype)
-    w = g @ a_blk                        # pass 1: (l, n)
+    # pass 1 (+4 fused): the Pallas kernel streams each A tile through
+    # VMEM once and feeds BOTH the sketch matmul and the Frobenius
+    # accumulation — XLA lowers them as separate reads here. Gated; the
+    # XLA form below is the fallback and the oracle.
+    norm_sq = None
+    from ._pallas_sketch import sketch_with_norm
+
+    fused = sketch_with_norm(g, a_blk)
+    if fused is not None:
+        w, norm_sq = fused               # passes 1+4 in one stream
+    else:
+        w = g @ a_blk                    # pass 1: (l, n)
     z = a_blk @ w.T                      # pass 2: (m, l); wᵀ is tiny
     qz = _gram_orthonormalize(z)
     b = qz.T @ a_blk                     # pass 3: (l, n); qzᵀ is tiny
@@ -192,7 +203,8 @@ def _sketched_uds_both(a_blk, keep: int, sketch_l: int, want: str = "left"):
         # restores the isometry contract without rotating columns.
         # σ=0 columns stay exactly zero (truncation noise, documented).
         v = _cholqr2_refine(v)
-    norm_sq = jnp.sum(a_blk * a_blk)     # pass 4
+    if norm_sq is None:
+        norm_sq = jnp.sum(a_blk * a_blk)  # pass 4 (unfused fallback)
     err_sq = jnp.maximum(norm_sq - jnp.sum(lam), 0.0)
     return u, v, s, err_sq, norm_sq
 

@@ -156,3 +156,25 @@ class TestGatherFreeStructure:
             comm.mesh, comm.axis_name, (x._phys.shape[0] // p,), 24, "float32"
         )
         self._assert_no_collectives(prog.lower(x._phys).as_text())
+
+
+class TestPallasSketchGate:
+    """The fused sketch+norm kernel must gate itself off everywhere the
+    Mosaic path can't run (CPU mesh, x64 mode, odd shapes) — the XLA
+    fallback is the oracle, asserted on the TPU by the verify drive."""
+
+    def test_gates(self):
+        import jax
+        import jax.numpy as jnp
+        from heat_tpu.core.linalg._pallas_sketch import sketch_with_norm
+
+        g = jnp.ones((25, 256), jnp.float32)
+        a = jnp.ones((256, 128), jnp.float32)
+        out = sketch_with_norm(g, a)
+        if jax.default_backend() != "tpu" or jax.config.jax_enable_x64:
+            assert out is None  # CPU mesh / x64: fallback path
+        # shape gates hold everywhere
+        assert sketch_with_norm(jnp.ones((40, 256), jnp.float32), a) is None  # l > pad
+        assert sketch_with_norm(jnp.ones((25, 100), jnp.float32),
+                                jnp.ones((100, 128), jnp.float32)) is None  # indivisible
+        assert sketch_with_norm(g.astype(jnp.bfloat16), a.astype(jnp.bfloat16)) is None

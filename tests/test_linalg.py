@@ -212,18 +212,90 @@ class TestSolver(TestCase):
 
 
 class TestTiling(TestCase):
-    def test_split_tiles(self):
+    def test_split_tiles_geometry(self):
         x = ht.arange(64, split=0).reshape(8, 8)
         tiles = ht.tiling.SplitTiles(x)
         self.assertEqual(len(tiles.tile_dimensions), 2)
         self.assertEqual(int(np.sum(tiles.tile_dimensions[0])), 8)
+        p = ht.get_comm().size
+        self.assertEqual(tiles.tile_ends_g.shape, (2, p))
+        self.assertEqual(int(tiles.tile_ends_g[0, -1]), 8)
+        self.assertEqual(tiles.lshape_map.shape, (p, 2))
 
-    def test_square_diag_tiles(self):
+    def test_split_tiles_reads_cover_array(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((13, 5)).astype(np.float32)  # uneven rows
+        x = ht.array(a, split=0)
+        tiles = ht.tiling.SplitTiles(x)
+        p = ht.get_comm().size
+        rebuilt = np.concatenate(
+            [tiles[i] for i in range(p) if tiles[i].shape[0] > 0], axis=0
+        )
+        np.testing.assert_allclose(rebuilt, a)
+        # tile-slice read
+        np.testing.assert_allclose(tiles[0:p], a)
+
+    def test_split_tiles_setitem_writes_through(self):
+        a = np.zeros((12, 4), dtype=np.float32)
+        x = ht.array(a, split=0)
+        tiles = ht.tiling.SplitTiles(x)
+        tiles[1] = 7.0
+        starts = np.concatenate([[0], np.cumsum(tiles.tile_dimensions[0])])
+        expect = a.copy()
+        expect[int(starts[1]): int(starts[2])] = 7.0
+        np.testing.assert_allclose(np.asarray(x.numpy()), expect)
+
+    def test_square_diag_tiles_geometry(self):
         x = ht.zeros((16, 16), split=0)
         tiles = ht.tiling.SquareDiagTiles(x, tiles_per_proc=2)
         self.assertGreaterEqual(tiles.tile_rows, 8)
         rows, cols = tiles.get_tile_size((0, 0))
         self.assertGreater(rows, 0)
+        rs, re, cs, ce = tiles.get_start_stop((0, 0))
+        self.assertEqual((re - rs, ce - cs), (rows, cols))
+        self.assertEqual(
+            tiles.tile_map.shape, (tiles.tile_rows, tiles.tile_columns)
+        )
+        self.assertEqual(
+            sum(tiles.tile_rows_per_process), tiles.tile_rows
+        )
+        self.assertLess(tiles.last_diagonal_process, ht.get_comm().size)
+
+    def test_square_diag_tiles_get_set_local(self):
+        rng = np.random.default_rng(1)
+        a = rng.standard_normal((16, 16)).astype(np.float32)
+        x = ht.array(a, split=0)
+        tiles = ht.tiling.SquareDiagTiles(x, tiles_per_proc=1)
+        # read: every tile matches its numpy region
+        for i in range(tiles.tile_rows):
+            for j in range(tiles.tile_columns):
+                rs, re, cs, ce = tiles.get_start_stop((i, j))
+                np.testing.assert_allclose(tiles[i, j], a[rs:re, cs:ce])
+        # write-through: zero the (0, 1) tile
+        tiles[0, 1] = 0.0
+        rs, re, cs, ce = tiles.get_start_stop((0, 1))
+        expect = a.copy()
+        expect[rs:re, cs:ce] = 0.0
+        np.testing.assert_allclose(np.asarray(x.numpy()), expect)
+        # local accessor: device 1's first local tile is the global tile
+        # offset by device 0's band
+        if ht.get_comm().size > 1:
+            gi, gj = tiles.local_to_global((0, 0), rank=1)
+            np.testing.assert_allclose(tiles.local_get((0, 0), rank=1), tiles[gi, gj])
+            tiles.local_set((0, 0), 3.5, rank=1)
+            rs, re, cs, ce = tiles.get_start_stop((gi, gj))
+            expect[rs:re, cs:ce] = 3.5
+            np.testing.assert_allclose(np.asarray(x.numpy()), expect)
+
+    def test_square_diag_tiles_match(self):
+        x = ht.zeros((16, 12), split=0)
+        q = ht.zeros((16, 16), split=0)
+        a_tiles = ht.tiling.SquareDiagTiles(x, tiles_per_proc=2)
+        q_tiles = ht.tiling.SquareDiagTiles(q, tiles_per_proc=1)
+        q_tiles.match_tiles(a_tiles)
+        # row boundaries adopted; column boundaries clipped to q's extent
+        self.assertEqual(q_tiles.row_indices, a_tiles.row_indices)
+        self.assertEqual(sum(q_tiles.tile_rows_per_process), q_tiles.tile_rows)
 
 
 if __name__ == "__main__":
