@@ -218,6 +218,162 @@ def measure_baseline() -> dict:
     out["sort"] = _best_of(lambda: torch.sort(srt), reps=2)
     del srt
 
+    # ---- lanczos (reference cb: linalg.py:38-40 — n=50, f64, m=n) ---- #
+    g = torch.Generator().manual_seed(7)
+    A50 = torch.randn(50, 50, dtype=torch.float64, generator=g)
+    B50 = A50 @ A50.T
+
+    def _lanczos_ref():
+        # the reference single-process path: m torch matvecs with full
+        # Gram-Schmidt reorthogonalization (reference solver.py:245-255)
+        n = B50.shape[0]
+        m = n
+        V = torch.zeros((n, m), dtype=B50.dtype)
+        v = torch.randn(n, dtype=B50.dtype, generator=g)
+        v = v / v.norm()
+        V[:, 0] = v
+        w = B50 @ v
+        a = w @ v
+        w = w - a * v
+        alpha, beta = [a], [torch.zeros((), dtype=B50.dtype)]
+        for i in range(1, m):
+            b = w.norm()
+            vi = w / b
+            vi = vi - V[:, :i] @ (V[:, :i].T @ vi)
+            vi = vi / vi.norm()
+            V[:, i] = vi
+            w = B50 @ vi
+            a = w @ vi
+            w = w - a * vi - b * V[:, i - 1]
+            alpha.append(a)
+            beta.append(b)
+        T = torch.diag(torch.stack(alpha))
+        off = torch.stack(beta[1:])
+        return V, T + torch.diag(off, 1) + torch.diag(off, -1)
+
+    out["lanczos_cb"] = _best_of(_lanczos_ref, reps=3)
+    del A50, B50
+
+    # ---- cluster fits (reference cb: cluster.py — 4x5000 spherical) ---- #
+    def _spherical_torch(n=5000):
+        gs = torch.Generator().manual_seed(1)
+        parts = []
+        for sign in (-2.0, -1.0, 1.0, 2.0):
+            d = torch.randn(n, 3, generator=gs)
+            d = d / d.norm(dim=1, keepdim=True).clamp_min(1e-30)
+            u = torch.rand(n, 1, generator=gs)
+            parts.append(d * u.pow(1.0 / 3.0) + sign * 4.0)
+        return torch.cat(parts)
+
+    sph = _spherical_torch()
+    k_cl = 4
+
+    def _kpp_seed(x, k, gen):
+        n = x.shape[0]
+        centers = [x[torch.randint(n, (1,), generator=gen)[0]]]
+        d2 = ((x - centers[0]) ** 2).sum(1)
+        for _ in range(k - 1):
+            idx = torch.multinomial(d2 / d2.sum(), 1, generator=gen)[0]
+            centers.append(x[idx])
+            d2 = torch.minimum(d2, ((x - centers[-1]) ** 2).sum(1))
+        return torch.stack(centers)
+
+    def _kmeans_fit_ref():
+        gen = torch.Generator().manual_seed(1)
+        c = _kpp_seed(sph, k_cl, gen)
+        for _ in range(300):
+            lab = torch.cdist(sph, c).argmin(1)
+            new = torch.stack(
+                [sph[lab == i].mean(0) if (lab == i).any() else c[i] for i in range(k_cl)]
+            )
+            shift = ((new - c) ** 2).sum()
+            c = new
+            if shift <= 1e-4:
+                break
+        return c
+
+    out["kmeans_fit_cb"] = _best_of(_kmeans_fit_ref, reps=3)
+
+    def _kmedians_fit_ref():
+        gen = torch.Generator().manual_seed(1)
+        c = _kpp_seed(sph, k_cl, gen)
+        for _ in range(300):
+            lab = torch.cdist(sph, c, p=1).argmin(1)
+            new = torch.stack(
+                [sph[lab == i].median(0).values if (lab == i).any() else c[i] for i in range(k_cl)]
+            )
+            shift = ((new - c) ** 2).sum()
+            c = new
+            if shift <= 1e-4:
+                break
+        return c
+
+    out["kmedians_fit_cb"] = _best_of(_kmedians_fit_ref, reps=3)
+
+    def _kmedoids_fit_ref():
+        gen = torch.Generator().manual_seed(1)
+        c = _kpp_seed(sph, k_cl, gen)
+        for _ in range(300):
+            lab = torch.cdist(sph, c, p=1).argmin(1)
+            new = []
+            for i in range(k_cl):
+                members = sph[lab == i]
+                if members.shape[0] == 0:
+                    new.append(c[i])
+                    continue
+                med = members.median(0).values
+                new.append(members[(members - med).abs().sum(1).argmin()])
+            new = torch.stack(new)
+            if (new == c).all():
+                break
+            c = new
+        return c
+
+    out["kmedoids_fit_cb"] = _best_of(_kmedoids_fit_ref, reps=3)
+    del sph
+
+    # ---- preprocessing scalers (reference cb: preprocessing.py — 5000x50,
+    # fit + transform + inverse, in place) ---- #
+    Xp = torch.randn(5000, 50, generator=g)
+
+    def _std_scaler():
+        m, s = Xp.mean(0), Xp.var(0).sqrt()
+        s = torch.where(s > 0, s, torch.ones_like(s))
+        y = (Xp - m) / s
+        return y * s + m
+
+    def _minmax_scaler():
+        lo, hi = Xp.min(0).values, Xp.max(0).values
+        rng = torch.where(hi - lo > 0, hi - lo, torch.ones_like(hi))
+        scale = 1.0 / rng
+        y = (Xp - lo) * scale
+        return y / scale + lo
+
+    def _maxabs_scaler():
+        s = Xp.abs().max(0).values
+        s = torch.where(s > 0, s, torch.ones_like(s))
+        y = Xp / s
+        return y * s
+
+    def _robust_scaler():
+        med = Xp.median(0).values
+        q1 = torch.quantile(Xp, 0.25, dim=0)
+        q3 = torch.quantile(Xp, 0.75, dim=0)
+        iqr = torch.where(q3 - q1 > 0, q3 - q1, torch.ones_like(q3))
+        y = (Xp - med) / iqr
+        return y * iqr + med
+
+    def _normalizer():
+        n = Xp.norm(dim=1, keepdim=True).clamp_min(1e-30)
+        return Xp / n
+
+    out["scaler_standard"] = _best_of(_std_scaler, reps=3)
+    out["scaler_minmax"] = _best_of(_minmax_scaler, reps=3)
+    out["scaler_maxabs"] = _best_of(_maxabs_scaler, reps=3)
+    out["scaler_robust"] = _best_of(_robust_scaler, reps=3)
+    out["normalizer_l2"] = _best_of(_normalizer, reps=3)
+    del Xp
+
     out["_meta"] = {
         "engine": "torch-cpu",
         "torch": torch.__version__,
@@ -320,19 +476,75 @@ def measure_heat_tpu() -> dict:
     method["kmeans_iter"] = "chained-slope"
     del x, cent0
 
-    # cb cluster config: full fit on 4x5000 spherical samples, kmeans++
-    # (host-driven convergence loop with internal syncs)
+    # cb cluster config: FULL fits (seeding + convergence loop + label
+    # assignment) on 4x5000 spherical samples. Chained-slope: each fit
+    # consumes the previous fit's centers via a corner write, so the
+    # ~100 ms tunnel read-back cancels out of the slope — what remains is
+    # dispatch + device time, the honest analog of the torch wallclock
+    # (which pays no tunnel tax).
     from heat_tpu.utils.data.spherical import create_spherical_dataset
     data = create_spherical_dataset(num_samples_cluster=5000, radius=1.0, offset=4.0,
                                     dtype=ht.float32, random_state=1)
-    def _km_fit():
-        km = ht.cluster.KMeans(n_clusters=4, init="kmeans++", random_state=1)
-        km.fit(data)
-        sync(km.cluster_centers_)
-    out["kmeans_fit_cb"] = _best_of(_km_fit, reps=2)
-    _progress("kmeans_fit_cb", out["kmeans_fit_cb"])
-    method["kmeans_fit_cb"] = "wallclock"
+
+    def _fit_step(cls, init):
+        def stepf(y):
+            km = cls(n_clusters=4, init=init, random_state=1)
+            km.fit(y)
+            y[0, 0] = km._cluster_centers.larray[0, 0] * 1e-30
+            return y
+        return stepf
+
+    fits = _chained_slope_group(
+        {
+            "kmeans_fit_cb": (data, _fit_step(ht.cluster.KMeans, "kmeans++")),
+            "kmedians_fit_cb": (data, _fit_step(ht.cluster.KMedians, "kmedians++")),
+            "kmedoids_fit_cb": (data, _fit_step(ht.cluster.KMedoids, "kmedoids++")),
+        },
+        sync, k1=2, k2=8, reps=4,
+    )
+    for kk, vv in fits.items():
+        out[kk] = vv
+        _progress(kk, vv)
+        method[kk] = "chained-slope (full fit incl. ++ seeding and labels)"
     del data
+
+    # lanczos (cb config: n=50, f64 — degrades to f32 on TPU per the
+    # platform-conditional x64 policy; the baseline runs true f64)
+    lz = ht.random.random((50, 50), dtype=ht.float64, split=0)
+    lzb = ht.matmul(lz, ht.transpose(lz))
+    def _lanczos_step(y):
+        V, T = ht.linalg.lanczos(y, 50)
+        y[0, 0] = T.larray[0, 0] * 1e-30  # result-derived write, no host sync
+        return y
+    out["lanczos_cb"] = _chained_slope(lzb, _lanczos_step, sync, k1=2, k2=10, reps=4)
+    _progress("lanczos_cb", out["lanczos_cb"])
+    method["lanczos_cb"] = "chained-slope (m=50 scan program; f64→f32 on TPU)"
+    del lz, lzb
+
+    # preprocessing scalers (cb config: 5000x50, fit+transform+inverse)
+    Xp = ht.random.randn(5000, 50, split=0)
+
+    def _fwd_inv(make):
+        def stepf(y):
+            sc = make()
+            return sc.inverse_transform(sc.fit_transform(y))
+        return stepf
+
+    scalers = _chained_slope_group(
+        {
+            "scaler_standard": (Xp, _fwd_inv(lambda: ht.preprocessing.StandardScaler(copy=False))),
+            "scaler_minmax": (Xp, _fwd_inv(lambda: ht.preprocessing.MinMaxScaler(copy=False))),
+            "scaler_maxabs": (Xp, _fwd_inv(lambda: ht.preprocessing.MaxAbsScaler(copy=False))),
+            "scaler_robust": (Xp, _fwd_inv(lambda: ht.preprocessing.RobustScaler(copy=False))),
+            "normalizer_l2": (Xp, lambda y: ht.preprocessing.Normalizer(copy=False).fit_transform(y)),
+        },
+        sync, k1=4, k2=24, reps=4,
+    )
+    for kk, vv in scalers.items():
+        out[kk] = vv
+        _progress(kk, vv)
+        method[kk] = "chained-slope (fit+transform+inverse)" if kk.startswith("scaler") else "chained-slope (fit+transform)"
+    del Xp
 
     # reshape there-and-back per step = 2 ops; slope halved
     r = ht.zeros(RESHAPE_SHAPE, split=1)
@@ -470,9 +682,12 @@ def measure_heat_tpu() -> dict:
     # device time (≈2 ms/pass) dominates dispatch cost.
     e = ht.random.randn(CHAIN_N, split=0)
     fused = jax.jit(lambda v: jnp.exp(jnp.sin(v) * 2.0 + v))
+    ht_fused = ht.jit(lambda y: ht.exp(ht.sin(y) * 2.0 + y))
     chain = _chained_slope_group(
         {
             "ht": (e, lambda y: ht.exp(ht.sin(y) * 2.0 + y)),
+            # the same public-op chain under ht.jit: ONE program, one dispatch
+            "ht_jit": (e, ht_fused),
             # raw unfused jnp (same 3 dispatches): isolates the WRAPPER overhead
             "raw": (e._phys, lambda y: jnp.exp(jnp.sin(y) * 2.0 + y)),
             # single fused program: the fusion gap any 3-call chain pays
@@ -482,11 +697,13 @@ def measure_heat_tpu() -> dict:
     )
     out["op_chain"] = chain["ht"]
     _progress("op_chain", out["op_chain"])
+    out["ht_jit_chain"] = chain["ht_jit"]
+    _progress("ht_jit_chain", out["ht_jit_chain"])
     out["op_chain_raw_jnp"] = chain["raw"]
     _progress("op_chain_raw_jnp", out["op_chain_raw_jnp"])
     out["op_chain_fused_jnp"] = chain["fused"]
     _progress("op_chain_fused_jnp", out["op_chain_fused_jnp"])
-    method["op_chain"] = method["op_chain_raw_jnp"] = method["op_chain_fused_jnp"] = "chained-slope"
+    method["op_chain"] = method["ht_jit_chain"] = method["op_chain_raw_jnp"] = method["op_chain_fused_jnp"] = "chained-slope"
     del e
 
     out["_method"] = method
@@ -577,6 +794,11 @@ def main() -> None:
     detail["op_chain"]["overhead_vs_fused_jnp"] = round(
         ours["op_chain"] / ours["op_chain_fused_jnp"], 3
     )
+    # the answer to the eager-dispatch gap: the same chain under ht.jit
+    # must track the hand-fused jnp program (≤1.2x)
+    detail["ht_jit_chain"]["overhead_vs_fused_jnp"] = round(
+        ours["ht_jit_chain"] / ours["op_chain_fused_jnp"], 3
+    )
     # sanity: one fused program must not lose to a 3-dispatch chain (a
     # violation means the measurement was dispatch/tunnel-bound, not a
     # device-time result — flagged instead of silently reported)
@@ -603,7 +825,42 @@ def main() -> None:
         "peaks": {"bf16_tflops": V5E_BF16_FLOPS / 1e12, "hbm_gbps": V5E_HBM_BPS / 1e9},
         "detail": detail,
     }
-    print(json.dumps(result))
+
+    # Full record to a file; stdout gets ONE compact line. The driver's
+    # tail capture is bounded (~2000 chars — BENCH_r03 was truncated
+    # mid-JSON and recorded parsed:null), so the parseable line must stay
+    # small: headline + the key chip rows only, everything else in
+    # BENCH_DETAIL.json.
+    detail_file = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+    with open(detail_file, "w") as f:
+        json.dump(result, f, indent=2)
+
+    def pick(row, *fields):
+        return {f: detail[row][f] for f in fields if f in detail[row]}
+
+    compact = {
+        "metric": f"hsvd_rank(r={HSVD_R}) GB/s/chip, {HSVD_BIG_M}x{HSVD_BIG_N} f32 (2.1GB north-star shard)",
+        "value": result["value"],
+        "unit": "GB/s",
+        "vs_baseline": result["vs_baseline"],
+        "platform": ours["_meta"]["platform"],
+        "key_rows": {
+            "matmul_bf16_8k": pick("matmul_bf16_8k", "mfu"),
+            "matmul_f32_8k": pick("matmul_f32_8k", "mfu"),
+            "ring_attention_16k_bf16": pick("ring_attention_16k_bf16", "mfu"),
+            "hsvd_2gb": pick("hsvd_2gb", "gbps", "passes_over_A", "hbm_frac_algorithmic"),
+            "sum_1gb": pick("sum_1gb", "hbm_frac"),
+            "sort_1gb": pick("sort_1gb", "melem_per_s"),
+            "op_chain": pick("op_chain", "overhead_vs_raw_jnp", "overhead_vs_fused_jnp"),
+            "ht_jit_chain": pick("ht_jit_chain", "overhead_vs_fused_jnp") if "ht_jit_chain" in detail else {},
+            "kmeans_fit_cb": pick("kmeans_fit_cb", "seconds", "speedup_vs_torch_cpu"),
+            "lanczos_cb": pick("lanczos_cb", "speedup_vs_torch_cpu") if "lanczos_cb" in detail else {},
+        },
+        "detail_file": "BENCH_DETAIL.json",
+    }
+    line = json.dumps(compact)
+    assert len(line) < 1500, f"compact bench line too long ({len(line)} chars)"
+    print(line)
 
 
 if __name__ == "__main__":

@@ -21,10 +21,11 @@ import jax.numpy as jnp
 
 from typing import Callable, Optional, Union
 
-from ..core import factories, random as ht_random, types
+from ..core import random as ht_random, types
 from ..core.base import BaseEstimator, ClusteringMixin
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
+from ..core.communication import place as _place
 
 __all__ = ["_KCluster"]
 
@@ -62,6 +63,35 @@ def make_fit_loop(step, jdtype: str, tol: float, max_iter: int, returns_inertia:
         return centers, it
 
     return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_fit_program(step, k: int, shape, jdtype: str, tol: float, max_iter: int,
+                       returns_inertia: bool, metric: str, seeded: bool):
+    """The ENTIRE fit — ++-seeding (when ``seeded``), the convergence
+    while_loop, and the final label assignment — as ONE jitted program:
+    a single dispatch per fit. The eager composite paid 3-4 dispatches
+    (seeding, loop, assignment, functional value), which dominated fit
+    time for cb-scale inputs on the remote TPU. ``init_arg`` is a PRNG
+    key when ``seeded`` else the (k, d) initial centers."""
+    loop = make_fit_loop(step, jdtype, tol, max_iter, returns_inertia)
+    seed_prog = _kmeanspp_program(k, shape, jdtype) if seeded else None
+
+    @jax.jit
+    def run(arr, init_arg):
+        centers0 = seed_prog(arr, init_arg) if seeded else init_arg.astype(arr.dtype)
+        res = loop(arr, centers0)
+        centers, n_iter = res[0], res[1]
+        d = _KCluster._pairwise(arr, centers, metric)
+        labels = jnp.argmin(d, axis=1).astype(jnp.int64)
+        if metric == "manhattan":
+            fun = jnp.sum(jnp.min(d, axis=1))
+        else:
+            fun = jnp.sum(jnp.min(d, axis=1) ** 2)
+        inertia = res[2] if returns_inertia else fun
+        return centers, n_iter, labels, inertia
+
+    return run
 
 
 @functools.lru_cache(maxsize=64)
@@ -182,7 +212,7 @@ class _KCluster(BaseEstimator, ClusteringMixin):
 
         # centers are replicated (small k×d)
         self._cluster_centers = DNDarray(
-            jax.device_put(centers, x.comm.sharding(2, None)),
+            _place(centers, x.comm.sharding(2, None)),
             (k, d),
             types.canonical_heat_type(centers.dtype),
             None,
@@ -248,6 +278,59 @@ class _KCluster(BaseEstimator, ClusteringMixin):
 
     def fit(self, x: DNDarray):
         raise NotImplementedError()
+
+    # ------------------------------------------------------------------ #
+    # shared fused fit driver                                            #
+    # ------------------------------------------------------------------ #
+    def _fit_fused(self, x: DNDarray, step_factory, returns_inertia: bool):
+        """Run the whole fit as one compiled program (see
+        ``_fused_fit_program``). ``step_factory(k, shape, jdtype)`` returns
+        the per-iteration update (Lloyd / median / medoid)."""
+        sanitize_in(x)
+        if x.ndim != 2:
+            raise ValueError(f"input needs to be 2-dimensional, got {x.ndim}")
+        k = self.n_clusters
+        arr = x.larray
+        if types.heat_type_is_exact(x.dtype):
+            arr = arr.astype(jnp.float32)
+
+        seeded = isinstance(self.init, str) and self.init in (
+            "probability_based", "kmeans++", "k-means++",
+        )
+        if seeded:
+            # same key derivation/state advance as _kmeanspp, so seeded
+            # results are identical to the composite path
+            state = ht_random.get_state()
+            init_arg = jax.random.fold_in(jax.random.PRNGKey(int(state[1])), int(state[2]))
+            ht_random.set_state((state[0], state[1], state[2] + k, 0, 0.0))
+        else:
+            self._initialize_cluster_centers(x)
+            init_arg = self._cluster_centers.larray
+
+        step = step_factory(k, tuple(arr.shape), np.dtype(arr.dtype).name)
+        prog = _fused_fit_program(
+            step, k, tuple(arr.shape), np.dtype(arr.dtype).name,
+            float(self.tol), int(self.max_iter), returns_inertia,
+            self._assignment_metric, seeded,
+        )
+        centers, n_iter_dev, labels, inertia_dev = prog(arr, init_arg)
+
+        self._n_iter = n_iter_dev  # lazy device scalars; properties read them
+        self._inertia = inertia_dev
+        self._cluster_centers = DNDarray(
+            _place(centers, x.comm.sharding(2, None)),
+            (k, x.shape[1]),
+            types.canonical_heat_type(centers.dtype),
+            None,
+            x.device,
+            x.comm,
+        )
+        gshape = (x.shape[0],)
+        split = 0 if x.split is not None else None
+        if split is not None:
+            labels = x.comm.shard(labels, split)
+        self._labels = DNDarray(labels, gshape, types.int64, split, x.device, x.comm)
+        return self
 
     def predict(self, x: DNDarray) -> DNDarray:
         """Labels of the closest cluster center for new data (reference:

@@ -13,8 +13,6 @@ from __future__ import annotations
 
 import functools
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 
@@ -22,8 +20,8 @@ from typing import Optional, Union
 
 from ..core import types
 from ..core.dndarray import DNDarray
-from ..core.sanitation import sanitize_in
 from ._kcluster import _KCluster
+from ..core.communication import place as _place
 
 __all__ = ["KMeans"]
 
@@ -80,16 +78,6 @@ def _lloyd_step(k: int, shape, jdtype: str, use_pallas: Optional[bool] = None):
     return step
 
 
-@functools.lru_cache(maxsize=64)
-def _lloyd_loop(k: int, shape, jdtype: str, tol: float, max_iter: int):
-    """The ENTIRE Lloyd fit as one jitted program (centers, n_iter,
-    inertia) — see ``_kcluster.make_fit_loop``."""
-    from ._kcluster import make_fit_loop
-
-    step = _lloyd_step(k, shape, jdtype, use_pallas=False)
-    return make_fit_loop(step, jdtype, tol, max_iter, returns_inertia=True)
-
-
 class KMeans(_KCluster):
     """K-Means with Lloyd's algorithm (reference: kmeans.py:17).
 
@@ -130,7 +118,7 @@ class KMeans(_KCluster):
         centers = self._cluster_centers.larray
         new_centers = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1), centers)
         return DNDarray(
-            jax.device_put(new_centers, x.comm.sharding(2, None)),
+            _place(new_centers, x.comm.sharding(2, None)),
             tuple(int(s) for s in new_centers.shape),
             types.canonical_heat_type(new_centers.dtype),
             None,
@@ -139,33 +127,8 @@ class KMeans(_KCluster):
         )
 
     def fit(self, x: DNDarray) -> "KMeans":
-        """Run Lloyd iterations to convergence (reference: kmeans.py:102)."""
-        sanitize_in(x)
-        if x.ndim != 2:
-            raise ValueError(f"input needs to be 2-dimensional, got {x.ndim}")
-        self._initialize_cluster_centers(x)
-
-        arr = x.larray
-        if types.heat_type_is_exact(x.dtype):
-            arr = arr.astype(jnp.float32)
-        centers = self._cluster_centers.larray.astype(arr.dtype)
-        # the whole fit is ONE on-device while_loop (no per-iteration host
-        # sync); n_iter/inertia come back in a single transfer
-        loop = _lloyd_loop(
-            self.n_clusters, tuple(arr.shape), np.dtype(arr.dtype).name,
-            float(self.tol), int(self.max_iter),
-        )
-        centers, n_iter_dev, inertia_dev = loop(arr, centers)
-        # keep as device scalars; n_iter_/inertia_ read them on access
-        self._n_iter = n_iter_dev
-        self._inertia = inertia_dev
-        self._cluster_centers = DNDarray(
-            jax.device_put(centers, x.comm.sharding(2, None)),
-            (self.n_clusters, x.shape[1]),
-            types.canonical_heat_type(centers.dtype),
-            None,
-            x.device,
-            x.comm,
-        )
-        self._labels = self._assign_to_cluster(x)
-        return self
+        """Run Lloyd iterations to convergence (reference: kmeans.py:102).
+        Seeding, the convergence while_loop and the final assignment run
+        as ONE compiled program — a single dispatch per fit (see
+        ``_kcluster._fused_fit_program``)."""
+        return self._fit_fused(x, _lloyd_step, returns_inertia=True)

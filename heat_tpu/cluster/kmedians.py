@@ -11,16 +11,12 @@ from __future__ import annotations
 
 import functools
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 
 from typing import Optional, Union
 
-from ..core import types
 from ..core.dndarray import DNDarray
-from ..core.sanitation import sanitize_in
 from ._kcluster import _KCluster
 
 __all__ = ["KMedians"]
@@ -45,15 +41,6 @@ def _median_step(k: int, shape, jdtype: str):
         return new_centers, shift
 
     return step
-
-
-@functools.lru_cache(maxsize=64)
-def _fit_loop(k: int, shape, jdtype: str, tol: float, max_iter: int):
-    """Whole fit as one jitted while_loop — see ``_kcluster.make_fit_loop``."""
-    from ._kcluster import make_fit_loop
-
-    step = _median_step(k, shape, jdtype)
-    return make_fit_loop(step, jdtype, tol, max_iter, returns_inertia=False)
 
 
 class KMedians(_KCluster):
@@ -83,27 +70,6 @@ class KMedians(_KCluster):
         )
 
     def fit(self, x: DNDarray) -> "KMedians":
-        sanitize_in(x)
-        if x.ndim != 2:
-            raise ValueError(f"input needs to be 2-dimensional, got {x.ndim}")
-        self._initialize_cluster_centers(x)
-        arr = x.larray
-        if types.heat_type_is_exact(x.dtype):
-            arr = arr.astype(jnp.float32)
-        centers = self._cluster_centers.larray.astype(arr.dtype)
-        loop = _fit_loop(
-            self.n_clusters, tuple(arr.shape), np.dtype(arr.dtype).name,
-            float(self.tol), int(self.max_iter),
-        )
-        centers, n_iter_dev = loop(arr, centers)
-        self._n_iter = n_iter_dev  # lazy device scalar; n_iter_ reads it
-        self._cluster_centers = DNDarray(
-            jax.device_put(centers, x.comm.sharding(2, None)),
-            (self.n_clusters, x.shape[1]),
-            types.canonical_heat_type(centers.dtype),
-            None,
-            x.device,
-            x.comm,
-        )
-        self._labels = self._assign_to_cluster(x, eval_functional_value=True)
-        return self
+        """Seeding + convergence loop + assignment as ONE compiled program
+        (see ``_kcluster._fused_fit_program``)."""
+        return self._fit_fused(x, _median_step, returns_inertia=False)

@@ -15,6 +15,7 @@ from .arithmetics import *
 from .complex_math import *
 from .exponential import *
 from .indexing import *
+from .jit import *
 from .io import *
 from .logical import *
 from .manipulations import *

@@ -70,6 +70,20 @@ def _platform_devices(device=None) -> list:
     return dev.jax_devices()
 
 
+def place(array: jax.Array, sharding) -> jax.Array:
+    """``jax.device_put`` that stays correct under tracing. Inside a
+    ``jax.jit`` trace (``ht.jit``, fused programs) ``jax.device_put`` on a
+    Tracer is NOT a binding layout constraint — observed on jax 0.9: the
+    requested sharding is silently ignored and GSPMD propagation picks its
+    own layout, leaving DNDarray ``split`` metadata out of sync with the
+    physical sharding. Under a trace this lowers to
+    ``with_sharding_constraint`` (which IS binding); eagerly it is a plain
+    ``device_put``."""
+    if isinstance(array, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(array, sharding)
+    return jax.device_put(array, sharding)
+
+
 def jit_sharded_mesh(fn, mesh, sharding_thunk):
     """``jax.jit`` with ``out_shardings`` from ``sharding_thunk()`` — except
     on a ONE-device mesh, where the pin is a semantic no-op (committed
@@ -262,9 +276,9 @@ class MeshCommunication(Communication):
             split = split % max(array.ndim, 1)
             if array.shape[split] == 0:
                 # zero-extent split axis: nothing to distribute, store replicated
-                return jax.device_put(array, self.sharding(array.ndim, None))
+                return place(array, self.sharding(array.ndim, None))
             array = _padding.pad_logical(array, split, self.size)
-        return jax.device_put(array, self.sharding(array.ndim, split))
+        return place(array, self.sharding(array.ndim, split))
 
     def reshard_phys(
         self, phys: jax.Array, gshape, old_split: Optional[int], new_split: Optional[int]

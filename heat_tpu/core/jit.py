@@ -1,0 +1,194 @@
+"""Fused-program surface: ``ht.jit``.
+
+The reference framework is eager: every ``heat.*`` call runs its own
+kernels (torch eager + MPI). This repo's eager path is already compiled
+per op, but a CHAIN of public ops still pays one XLA program dispatch per
+op — measured at ~3.4x the cost of the equivalent single fused program
+for a 6-op elementwise chain (bench.py ``op_chain``). The reference has
+no answer to this; on TPU the answer is the same one JAX gives:
+trace the whole user function into ONE XLA program.
+
+``ht.jit(fn)`` wraps a function of DNDarrays (any pytree of DNDarrays,
+jax arrays and static Python values) so that every ``heat_tpu`` op inside
+it is traced — metadata propagation (gshape/split/dtype) runs once at
+trace time, the array math fuses into a single program, and XLA inserts
+the collectives implied by the shardings. Works because every public op
+routes device math through ``jnp``/``lax`` on the physical array and
+keeps host control flow metadata-only.
+
+Limitations (clear errors, not wrong answers):
+
+- Ops whose OUTPUT SHAPE depends on data (``unique``, ``nonzero``,
+  boolean-mask indexing) cannot be traced — they need a host read of
+  counts. Calling them under ``ht.jit`` raises jax's concretization
+  error, re-raised with a pointer here. Use them eagerly, outside.
+- DNDarrays closed over (not passed as arguments) are baked into the
+  program as constants; pass arrays as arguments.
+- The traced function must be functional on its DNDarray arguments:
+  in-place ``x[i] = v`` on an ARGUMENT mutates the Python wrapper at
+  trace time only, it does not feed back to the caller's array.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+
+from typing import Callable, Optional
+
+from .dndarray import DNDarray
+
+__all__ = ["jit"]
+
+
+def _is_leaf(x) -> bool:
+    return isinstance(x, DNDarray)
+
+
+class _DndSpec:
+    """Hashable trace signature of a DNDarray argument: everything the
+    metadata path can branch on must be part of the program cache key."""
+
+    __slots__ = ("gshape", "dtype", "split", "device", "comm")
+
+    def __init__(self, d: DNDarray):
+        self.gshape = d.shape
+        self.dtype = d.dtype
+        self.split = d.split
+        self.device = d.device
+        self.comm = d.comm
+
+    def _key(self):
+        return (self.gshape, self.dtype, self.split, str(self.device), id(self.comm))
+
+    def __eq__(self, other):
+        return isinstance(other, _DndSpec) and self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def rebuild(self, phys) -> DNDarray:
+        return DNDarray(phys, self.gshape, self.dtype, self.split, self.device, self.comm)
+
+
+def _leaf_spec(leaf):
+    """(kind, spec) — kind decides traced-vs-static; spec keys the cache."""
+    if isinstance(leaf, DNDarray):
+        return ("dnd", _DndSpec(leaf))
+    if isinstance(leaf, jax.Array):
+        # weak_type participates in jax.jit's own retrace key; omitting it
+        # here would let two jax-level traces share one ht-level cache entry
+        return ("jax", (leaf.shape, str(leaf.dtype), bool(leaf.aval.weak_type)))
+    if isinstance(leaf, np.ndarray):
+        return ("np", (leaf.shape, str(leaf.dtype)))
+    # everything else is static: part of the cache key, baked into the trace
+    try:
+        hash(leaf)
+    except TypeError:
+        raise TypeError(
+            f"ht.jit argument of type {type(leaf).__name__} is neither an array "
+            "nor hashable — pass arrays (DNDarray/jax/numpy) or hashable statics"
+        ) from None
+    return ("static", leaf)
+
+
+def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
+    """Trace ``fn`` (a function over DNDarrays) into one fused XLA program.
+
+    Usable as ``ht.jit(fn)`` or ``@ht.jit``. Additional keyword arguments
+    are forwarded to ``jax.jit`` (e.g. ``donate_argnums`` is NOT supported
+    — donation operates on the flattened physical leaves, which do not
+    align with user-visible argument positions).
+
+    Examples
+    --------
+    >>> @ht.jit
+    ... def gram_norms(x):
+    ...     g = ht.matmul(x, ht.transpose(x))
+    ...     return ht.sqrt(ht.sum(g * g, axis=1))
+    >>> y = gram_norms(a)       # one compiled program, one dispatch
+    """
+    if fn is None:
+        return lambda f: jit(f, **jit_kwargs)
+    if "donate_argnums" in jit_kwargs or "donate_argnames" in jit_kwargs:
+        raise TypeError("ht.jit does not support donation (leaf positions are internal)")
+
+    cache: dict = {}
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_leaf)
+        specs = tuple(_leaf_spec(leaf) for leaf in leaves)
+        key = (treedef, specs)
+
+        entry = cache.get(key)
+        if entry is None:
+            out_box = []
+
+            def inner(traced):
+                # NOTE: closes over `specs` (metadata) only — never over
+                # `leaves`, which would pin the first call's device buffers
+                # in HBM for the lifetime of the cache entry
+                it = iter(traced)
+                rebuilt = []
+                for kind, spec in specs:
+                    if kind == "dnd":
+                        rebuilt.append(spec.rebuild(next(it)))
+                    elif kind in ("jax", "np"):
+                        rebuilt.append(next(it))
+                    else:
+                        rebuilt.append(spec)
+                a, kw = jax.tree.unflatten(treedef, rebuilt)
+                try:
+                    res = fn(*a, **kw)
+                except (
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError,
+                    jax.errors.TracerBoolConversionError,
+                ) as e:
+                    raise TypeError(
+                        "ht.jit: an op inside the traced function needs the array's "
+                        "VALUES on the host (data-dependent output shape — unique/"
+                        "nonzero/boolean-mask indexing — or a float()/int() read). "
+                        "Run that op eagerly, outside ht.jit. Original: " + str(e)
+                    ) from None
+                out_leaves, out_treedef = jax.tree.flatten(res, is_leaf=_is_leaf)
+                phys_out, out_meta = [], []
+                for o in out_leaves:
+                    if isinstance(o, DNDarray):
+                        out_meta.append(_DndSpec(o))
+                        phys_out.append(o._phys)
+                    else:
+                        out_meta.append(None)
+                        phys_out.append(o)
+                out_box.append((out_treedef, out_meta))
+                return tuple(phys_out)
+
+            entry = (jax.jit(inner, **jit_kwargs), out_box)
+            cache[key] = entry
+
+        jitted, out_box = entry
+        traced_in = [
+            leaf._phys if isinstance(leaf, DNDarray) else leaf
+            for leaf, (kind, _) in zip(leaves, specs)
+            if kind != "static"
+        ]
+        phys_out = jitted(traced_in)
+        if not out_box:
+            # cache hit on a program jax.jit compiled earlier but whose
+            # out-metadata box was lost — cannot happen (box fills on first
+            # trace, same entry), guarded for safety
+            raise RuntimeError("ht.jit internal: missing output metadata")
+        # [-1]: if jax.jit retraced under this same ht-level key (its own
+        # key is finer), the LAST trace's metadata describes this call
+        out_treedef, out_meta = out_box[-1]
+        rebuilt_out = [
+            m.rebuild(p) if m is not None else p for m, p in zip(out_meta, phys_out)
+        ]
+        return jax.tree.unflatten(out_treedef, rebuilt_out)
+
+    wrapper._ht_jit_cache = cache  # introspection/testing hook
+    return wrapper

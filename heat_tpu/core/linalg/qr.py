@@ -136,7 +136,7 @@ def qr(
             r = fn(phys)
             q_arr = None
         r_arr = DNDarray(
-            jax.device_put(r, comm.sharding(2, None)), tuple(int(s) for s in r.shape), dtype, None, a.device, comm
+            _place(r, comm.sharding(2, None)), tuple(int(s) for s in r.shape), dtype, None, a.device, comm
         )
         return QR(q_arr, r_arr)
 
@@ -179,6 +179,7 @@ def qr(
 DNDarray.qr = qr
 
 from ..communication import register_mesh_cache
+from ..communication import place as _place
 
 # entries bake mesh geometry: cleared when init_distributed rebuilds the world
 register_mesh_cache(_tsqr_fn)

@@ -134,6 +134,21 @@ def _lanczos_program(n: int, m: int, jdtype: str, breakdown_tol: float):
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=32)
+def _tridiag_program(m: int, jdtype: str):
+    """(alpha, beta) -> tridiagonal T, on device (no host round trip)."""
+
+    @jax.jit
+    def build(alpha, beta):
+        return (
+            jnp.diag(alpha)
+            + jnp.diag(beta[1:], 1)
+            + jnp.diag(beta[1:], -1)
+        ).astype(jdtype)
+
+    return build
+
+
 def lanczos(
     A: DNDarray,
     m: int,
@@ -174,6 +189,8 @@ def lanczos(
         alpha = np.array([float(basics.matmul(w, v0))])
         beta = np.zeros(1)
         V_arr = v0.larray[:, None]
+        T_np = np.diag(alpha) + np.diag(beta[1:], 1) + np.diag(beta[1:], -1)
+        T_arr = None
     else:
         prog = _lanczos_program(n, m, np.dtype(jt).name, 1e-10)
         # breakdown-restart directions come from a dedicated fixed stream:
@@ -183,8 +200,10 @@ def lanczos(
         # breakdown — and (b) block on a ~90 ms host read-back per call
         key = jax.random.key(0x1A2C05)
         V_arr, alpha_d, beta_d = prog(A.larray.astype(jt), v0.larray, key)
-        alpha = np.asarray(jax.device_get(alpha_d), dtype=np.float64)
-        beta = np.asarray(jax.device_get(beta_d), dtype=np.float64)
+        # T assembles ON DEVICE: a host device_get of alpha/beta here would
+        # cost a blocking ~100 ms round trip per call over the remote
+        # tunnel (and a sync the reference's torch path does not pay)
+        T_arr = _tridiag_program(m, np.dtype(jt).name)(alpha_d, beta_d)
 
     V = DNDarray(
         A.comm.shard(V_arr, A.split if A.split in (0, None) else 0),
@@ -194,8 +213,12 @@ def lanczos(
         A.device,
         A.comm,
     )
-    T_np = np.diag(alpha) + np.diag(beta[1:], 1) + np.diag(beta[1:], -1)
-    T = factories.array(T_np, dtype=dtype, comm=A.comm, device=A.device)
+    if T_arr is None:
+        T = factories.array(T_np, dtype=dtype, comm=A.comm, device=A.device)
+    else:
+        T = DNDarray(
+            A.comm.shard(T_arr, None), (m, m), dtype, None, A.device, A.comm
+        )
 
     if V_out is not None:
         V_out.larray = V.larray

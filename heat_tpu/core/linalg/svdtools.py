@@ -301,7 +301,7 @@ def _err_scalar(val, A: DNDarray) -> DNDarray:
     if types.heat_type_is_exact(types.canonical_heat_type(arr.dtype)):
         arr = arr.astype(jnp.float32)
     return DNDarray(
-        jax.device_put(arr, A.comm.sharding(0, None)),
+        _place(arr, A.comm.sharding(0, None)),
         (),
         types.canonical_heat_type(arr.dtype),
         None,
@@ -575,7 +575,7 @@ def _hsvd_impl(
 
     sigma_arr = jnp.asarray(s_np)
     sigma = DNDarray(
-        jax.device_put(sigma_arr, comm.sharding(1, None)),
+        _place(sigma_arr, comm.sharding(1, None)),
         (int(sigma_arr.shape[0]),),
         dtype,
         None,
@@ -670,6 +670,7 @@ def _choose_rank(
     return max(1, r)
 
 from ..communication import register_mesh_cache
+from ..communication import place as _place
 
 # entries bake mesh geometry: cleared when init_distributed rebuilds the world
 register_mesh_cache(_local_svd_fn)

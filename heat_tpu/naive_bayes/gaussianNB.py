@@ -21,6 +21,7 @@ from ..core import factories, types
 from ..core.base import BaseEstimator, ClassificationMixin
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
+from ..core.communication import place as _place
 
 __all__ = ["GaussianNB"]
 
@@ -118,7 +119,7 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
 
         comm, device = x.comm, x.device
         mk = lambda a: DNDarray(
-            jax.device_put(a, comm.sharding(a.ndim, None)),
+            _place(a, comm.sharding(a.ndim, None)),
             tuple(int(s) for s in a.shape),
             types.canonical_heat_type(a.dtype),
             None,

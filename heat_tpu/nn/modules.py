@@ -26,6 +26,8 @@ import jax.numpy as jnp
 
 from typing import Any, Optional, Sequence, Tuple
 
+from ..core.communication import place as _place
+
 __all__ = [
     "Module",
     "Linear",
@@ -395,7 +397,7 @@ def scalar_dndarray(val, comm, device):
     from ..core import types
 
     return DNDarray(
-        jax.device_put(val, comm.sharding(0, None)),
+        _place(val, comm.sharding(0, None)),
         (),
         types.canonical_heat_type(val.dtype),
         None,

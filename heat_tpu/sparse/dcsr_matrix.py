@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from typing import Optional, Tuple, Union
 
 from ..core import types
-from ..core.communication import Communication, sanitize_comm
+from ..core.communication import Communication, place as _place, sanitize_comm
 from ..core.devices import Device
 from ..core.dndarray import DNDarray
 from ..core import _padding
@@ -130,9 +130,7 @@ class DCSR_matrix:
             # keep the nnz-axis layout of indices/data: an unsharded row
             # map would add O(gnnz) resident bytes per device
             if self.__split == 0:
-                rows = jax.device_put(
-                    rows, self.__comm.sharding(1, 0)
-                )
+                rows = _place(rows, self.__comm.sharding(1, 0))
             self.__rows_cache = rows
         return self.__rows_cache
 
@@ -258,7 +256,7 @@ class DCSR_matrix:
             raise ValueError("This method works only for distributed matrices")
         idx_t = types.canonical_heat_type(self.__indptr.dtype)
         return DNDarray(
-            jax.device_put(self.__indptr, self.__comm.sharding(1, None)),
+            _place(self.__indptr, self.__comm.sharding(1, None)),
             (self.__gshape[0] + 1,),
             idx_t,
             None,

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from typing import Iterable, Optional, Type
 
 from ..core import types
-from ..core.communication import Communication, sanitize_comm
+from ..core.communication import Communication, place as _place, sanitize_comm
 from ..core.devices import Device, sanitize_device
 from .dcsr_matrix import DCSR_matrix
 
@@ -37,7 +37,7 @@ def _from_components(indptr, indices, data, gshape, split, device, comm) -> DCSR
     gnnz = int(indices.shape[0])
     dtype = types.canonical_heat_type(data.dtype)
     return DCSR_matrix(
-        jax.device_put(indptr, comm.sharding(1, None)),
+        _place(indptr, comm.sharding(1, None)),
         _shard_nnz(comm, indices, split),
         _shard_nnz(comm, data, split),
         gnnz,

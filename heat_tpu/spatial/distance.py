@@ -27,6 +27,7 @@ from typing import Callable, Optional
 from ..core import types
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
+from ..core.communication import place as _place
 
 __all__ = ["cdist", "manhattan", "rbf"]
 
@@ -97,7 +98,7 @@ def _ring_path(X: DNDarray, Y: Optional[DNDarray], metric: str, dtype) -> Option
     # (they hold distances computed against pad zeros). No unpad/repad
     # round trip of the n×m matrix.
     phys = _padding.mask_phys(out[:, : gshape[1]], gshape, 0)
-    phys = jax.device_put(phys, comm.sharding(2, 0))
+    phys = _place(phys, comm.sharding(2, 0))
     return DNDarray(phys, gshape, dtype, 0, X.device, comm)
 
 

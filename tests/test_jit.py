@@ -1,0 +1,114 @@
+"""Tests for ``ht.jit`` — the fused-program surface (no reference analog;
+the reference is torch-eager throughout, bench.py ``op_chain`` measures
+the dispatch gap this closes)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from test_suites.basic_test import TestCase
+
+
+class TestHtJit(TestCase):
+    def test_elementwise_chain_matches_eager(self):
+        x = ht.random.randn(257, 3, split=0)  # odd length exercises padding
+
+        def chain(y):
+            return ht.exp(ht.sin(y) * 2.0 + y)
+
+        fused = ht.jit(chain)
+        out = fused(x)
+        ref = chain(x)
+        self.assertEqual(out.split, ref.split)
+        self.assertEqual(out.shape, ref.shape)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_matmul_reduction_sharded(self):
+        x = ht.random.randn(64, 8, split=0)
+
+        @ht.jit
+        def gram_rows(y):
+            g = ht.matmul(y, ht.transpose(y))
+            return ht.sum(g, axis=1)
+
+        out = gram_rows(x)
+        ref = ht.sum(ht.matmul(x, ht.transpose(x)), axis=1)
+        self.assertEqual(out.split, ref.split)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_resplit_inside(self):
+        x = ht.random.randn(32, 16, split=0)
+        fused = ht.jit(lambda y: ht.mean(y.resplit(1), axis=0))
+        ref = ht.mean(x.resplit(1), axis=0)
+        np.testing.assert_allclose(fused(x).numpy(), ref.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_pytree_in_out(self):
+        a = ht.arange(12, split=0).astype(ht.float32)
+        b = ht.ones((12,), split=0)
+
+        @ht.jit
+        def f(pair, scale):
+            s = pair["a"] + pair["b"] * scale
+            return {"sum": s, "total": ht.sum(s)}
+
+        out = f({"a": a, "b": b}, 3.0)
+        np.testing.assert_allclose(
+            out["sum"].numpy(), np.arange(12, dtype=np.float32) + 3.0
+        )
+        self.assertAlmostEqual(float(out["total"]), float(np.sum(np.arange(12) + 3.0)), places=3)
+
+    def test_single_program_and_cache(self):
+        calls = [0]
+
+        def chain(y):
+            calls[0] += 1
+            return ht.sqrt(ht.abs(y)) + 1.0
+
+        fused = ht.jit(chain)
+        x = ht.random.randn(64, split=0)
+        fused(x)
+        fused(x + 1.0)  # same signature: no retrace
+        self.assertEqual(calls[0], 1)
+        self.assertEqual(len(fused._ht_jit_cache), 1)
+        fused(ht.random.randn(32, split=0))  # new shape: retrace
+        self.assertEqual(calls[0], 2)
+        self.assertEqual(len(fused._ht_jit_cache), 2)
+
+    def test_static_scalar_keys_cache(self):
+        fused = ht.jit(lambda y, p: y**p)
+        x = ht.full((8,), 2.0, split=0)
+        np.testing.assert_allclose(fused(x, 2).numpy(), np.full(8, 4.0))
+        np.testing.assert_allclose(fused(x, 3).numpy(), np.full(8, 8.0))
+        self.assertEqual(len(fused._ht_jit_cache), 2)
+
+    def test_resplit_physical_sharding_under_jit(self):
+        # jax.device_put on a Tracer is not a binding constraint (the
+        # sharding is silently dropped); communication.place must lower to
+        # with_sharding_constraint under trace so split metadata and the
+        # physical layout stay in sync
+        x = ht.random.randn(64, 8, split=0)
+        out = ht.jit(lambda y: y.resplit(1))(x)
+        self.assertEqual(out.split, 1)
+        eager = x.resplit(1)
+        self.assertEqual(
+            {s.data.shape for s in out._phys.addressable_shards},
+            {s.data.shape for s in eager._phys.addressable_shards},
+        )
+
+    def test_data_dependent_op_raises_helpfully(self):
+        x = ht.array([1.0, 0.0, 2.0, 0.0], split=0)
+        fused = ht.jit(lambda y: ht.nonzero(y))
+        with pytest.raises(TypeError, match="ht.jit"):
+            fused(x)
+
+    def test_mixed_dtypes_and_int_output(self):
+        x = ht.random.randn(40, split=0)
+
+        @ht.jit
+        def f(y):
+            return ht.argmax(y), y * 2.0
+
+        idx, doubled = f(x)
+        self.assertEqual(int(idx), int(np.argmax(x.numpy())))
+        np.testing.assert_allclose(doubled.numpy(), x.numpy() * 2.0, rtol=1e-6)
