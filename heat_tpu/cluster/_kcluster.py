@@ -30,6 +30,17 @@ from ..core.communication import place as _place
 __all__ = ["_KCluster"]
 
 
+def _seed_key(k: int) -> jax.Array:
+    """Derive the seeding PRNG key from the global heat stream and advance
+    it by the k draws the ++-seeding consumes. The single source of truth
+    for BOTH the composite ``_kmeanspp`` path and the fused fit — they
+    must derive identically or seeded results diverge between paths."""
+    state = ht_random.get_state()
+    key = jax.random.fold_in(jax.random.PRNGKey(int(state[1])), int(state[2]))
+    ht_random.set_state((state[0], state[1], state[2] + k, 0, 0.0))
+    return key
+
+
 def make_fit_loop(step, jdtype: str, tol: float, max_iter: int, returns_inertia: bool):
     """Whole-fit while_loop with on-device convergence (a host check per
     iteration costs a ~90 ms tunnel round trip). ``step(arr, centers)``
@@ -83,7 +94,7 @@ def _fused_fit_program(step, k: int, shape, jdtype: str, tol: float, max_iter: i
         res = loop(arr, centers0)
         centers, n_iter = res[0], res[1]
         d = _KCluster._pairwise(arr, centers, metric)
-        labels = jnp.argmin(d, axis=1).astype(jnp.int64)
+        labels = jnp.argmin(d, axis=1).astype(types.index_jax_type())
         if metric == "manhattan":
             fun = jnp.sum(jnp.min(d, axis=1))
         else:
@@ -229,11 +240,8 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         The whole seeding is ONE jitted program (the eager unrolled loop
         cost ~20 dispatches, each a millisecond-class round trip over the
         remote execution tunnel)."""
-        state = ht_random.get_state()
-        key = jax.random.fold_in(jax.random.PRNGKey(int(state[1])), int(state[2]))
-        ht_random.set_state((state[0], state[1], state[2] + k, 0, 0.0))
         prog = _kmeanspp_program(k, tuple(arr.shape), np.dtype(arr.dtype).name)
-        return prog(arr, key)
+        return prog(arr, _seed_key(k))
 
     # ------------------------------------------------------------------ #
     # assignment (reference: _kcluster.py:196-209)                       #
@@ -250,7 +258,7 @@ class _KCluster(BaseEstimator, ClusteringMixin):
             arr = arr.astype(jnp.float32)
         c = self._cluster_centers.larray
         d = self._pairwise(arr, c, self._assignment_metric)
-        labels = jnp.argmin(d, axis=1).astype(jnp.int64)
+        labels = jnp.argmin(d, axis=1).astype(types.index_jax_type())
         if eval_functional_value:
             if self._assignment_metric == "manhattan":
                 # L1 functional value (lazy device scalar, read by inertia_)
@@ -298,11 +306,9 @@ class _KCluster(BaseEstimator, ClusteringMixin):
             "probability_based", "kmeans++", "k-means++",
         )
         if seeded:
-            # same key derivation/state advance as _kmeanspp, so seeded
-            # results are identical to the composite path
-            state = ht_random.get_state()
-            init_arg = jax.random.fold_in(jax.random.PRNGKey(int(state[1])), int(state[2]))
-            ht_random.set_state((state[0], state[1], state[2] + k, 0, 0.0))
+            # the SHARED derivation keeps seeded results identical between
+            # the fused fit and the composite _kmeanspp path
+            init_arg = _seed_key(k)
         else:
             self._initialize_cluster_centers(x)
             init_arg = self._cluster_centers.larray

@@ -123,36 +123,16 @@ def use_x64(flag: "Optional[bool]" = None) -> bool:
     return bool(jax.config.jax_enable_x64)
 
 
-_TRUNCATION_FILTER_ON = False
-
-
 def _set_x64(enable: bool) -> None:
-    import warnings
-
     from . import types as _types
 
-    global _TRUNCATION_FILTER_ON
+    # No warnings-filter games: internal code never requests a 64-bit jax
+    # dtype in degrade mode (it routes through types.index_jax_type /
+    # wide_jax_type), so JAX's truncation warnings stay untouched for the
+    # user's own calls (ADVICE r3: a process-global filter suppressed
+    # them for ALL code in the process).
     jax.config.update("jax_enable_x64", bool(enable))
     _types._DEGRADE_64 = not enable
-    if not enable and not _TRUNCATION_FILTER_ON:
-        # the 64->32 degradation is a documented platform policy; JAX's
-        # per-op truncation warnings would fire on every internal int64
-        # index cast. Installed once; removed again on re-enable so user
-        # code keeps its genuine-truncation warnings in x64 mode.
-        warnings.filterwarnings(
-            "ignore", message=".*will be truncated to dtype.*", category=UserWarning
-        )
-        _TRUNCATION_FILTER_ON = True
-    elif enable and _TRUNCATION_FILTER_ON:
-        warnings.filters[:] = [
-            f for f in warnings.filters
-            if not (
-                f[0] == "ignore"
-                and f[1] is not None
-                and getattr(f[1], "pattern", "") == ".*will be truncated to dtype.*"
-            )
-        ]
-        _TRUNCATION_FILTER_ON = False
 
 
 def _apply_x64_policy(backend: str) -> None:

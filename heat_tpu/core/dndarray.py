@@ -873,7 +873,7 @@ class DNDarray:
                 # its own domain (valid all-False → silent drop) and a
                 # narrow int8/int16 key cannot hold the physical-extent
                 # sentinel
-                k = jnp.asarray(key).astype(jnp.int64)
+                k = jnp.asarray(key).astype(types.index_jax_type())
                 # out-of-range logical indices must NOT land in the pad
                 # region (physically in-bounds would corrupt the zero-pad
                 # invariant TSQR etc. rely on): remap anything outside

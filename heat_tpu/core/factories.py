@@ -74,7 +74,7 @@ def _cached_creator(mesh, axis_name: str, op_key: str, shape, jdtype, split, arg
     # the resulting array is embedded into the HLO as a full constant —
     # a 100M-element ht.arange then ships a 400 MB compile request.
     def _iota_1d(n):
-        wide = jnp.int64 if jnp.issubdtype(jnp.dtype(jdtype), jnp.integer) else jnp.float64
+        wide = types.wide_jax_type('i' if jnp.issubdtype(jnp.dtype(jdtype), jnp.integer) else 'f')
         return jax.lax.iota(wide, n)
 
     def build():
@@ -93,7 +93,7 @@ def _cached_creator(mesh, axis_name: str, op_key: str, shape, jdtype, split, arg
             start, stop, num, endpoint = args
             div = (num - 1) if endpoint else num
             delta = (stop - start) / div if div > 0 else 0.0
-            logical = jax.lax.iota(jnp.float64, num) * delta + start
+            logical = jax.lax.iota(types.wide_jax_type('f'), num) * delta + start
             if endpoint and num > 1:
                 # pin the final sample to stop exactly (np.linspace semantics;
                 # iota*delta accumulates one rounding step at the endpoint)

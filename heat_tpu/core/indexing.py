@@ -43,8 +43,8 @@ def nonzero(x: DNDarray) -> DNDarray:
             return DNDarray(comm.shard(phys, 0), gshape, types.int64, 0, x.device, comm)
         return DNDarray(phys, gshape, types.int64, 0, x.device, comm)
     idx = jnp.nonzero(x.larray)
-    stacked = jnp.stack(idx, axis=1) if x.ndim > 0 else jnp.zeros((0, 0), dtype=jnp.int64)
-    stacked = stacked.astype(jnp.int64)
+    stacked = jnp.stack(idx, axis=1) if x.ndim > 0 else jnp.zeros((0, 0), dtype=types.index_jax_type())
+    stacked = stacked.astype(types.index_jax_type())
     split = 0 if x.split is not None else None
     gshape = tuple(int(s) for s in stacked.shape)
     if split is not None:

@@ -131,14 +131,13 @@ def _multiprocess_gather_for_save(data: DNDarray):
     (reference io.py:214) and netCDF4 ``parallel=True`` (io.py:585); a
     plain multi-writer 'w' open truncates per process and corrupts).
 
-    In a multi-process world the array is allgathered (COLLECTIVE —
-    every process must call save) and only process 0 touches the file;
-    ``_sync_processes`` afterwards keeps other hosts from reading a
-    half-written file.
+    FULL-array gather — every host materializes the whole array. Kept
+    only for the netCDF append-region path, whose target geometry cannot
+    be decomposed into split-blocks; the main save paths stream bounded
+    slabs via ``_multiprocess_save_slabs`` instead (ADVICE r3: the full
+    allgather OOMs hosts at the 200 GB north-star scale).
 
-    Returns ``(is_multiprocess, host_array_or_None)`` — the host array is
-    returned on every process (the allgather is collective) but only
-    process 0 should write it.
+    Returns ``(is_multiprocess, host_array_or_None)``.
     """
     if jax.process_count() == 1:
         return False, None
@@ -146,6 +145,43 @@ def _multiprocess_gather_for_save(data: DNDarray):
     if data.dtype is types.bfloat16:
         arr = np.asarray(arr, dtype=np.float32)
     return True, np.asarray(arr)
+
+
+def _multiprocess_save_slabs(data: DNDarray):
+    """Yield ``(global_slices, host_block)`` for a single-writer
+    multi-process save with BOUNDED host memory: ONE split-block is
+    allgathered per round (a collective — every process must drain the
+    iterator, in step), never the whole array. Only process 0 should
+    write the yielded slabs; other processes receive them too (the
+    allgather is symmetric) and drop them immediately."""
+    from jax.experimental import multihost_utils
+
+    arr = data._phys
+    if data.dtype is types.bfloat16:
+        arr = arr.astype(jnp.float32)
+    split = data.split
+    if split is None or arr.is_fully_addressable:
+        host = np.asarray(jax.device_get(arr))
+        if host.shape != tuple(data.shape):
+            host = host[tuple(slice(0, s) for s in data.shape)]
+        yield tuple(slice(0, s) for s in data.shape), host
+        return
+    n = data.shape[split]
+    block = arr.shape[split] // data.comm.size
+    for r in range(data.comm.size):
+        start = r * block
+        stop = min(start + block, n)
+        if stop <= start:
+            continue
+        idx = [slice(None)] * data.ndim
+        idx[split] = slice(start, stop)
+        slab = arr[tuple(idx)]  # global slice of the sharded array
+        host = np.asarray(multihost_utils.process_allgather(slab, tiled=True))
+        sl = tuple(
+            slice(start, stop) if i == split else slice(0, s)
+            for i, s in enumerate(data.shape)
+        )
+        yield sl, host[tuple(slice(0, s.stop - s.start) for s in sl)]
 
 
 def _sync_processes(tag: str) -> None:
@@ -242,13 +278,20 @@ if __HDF5:
         if not isinstance(path, str):
             raise TypeError(f"path must be str, got {type(path)}")
         np_dtype = kwargs.pop("dtype", _np_storage_dtype(data.dtype))  # h5py casts on write
-        multi, host_arr = _multiprocess_gather_for_save(data)
-        if multi:
+        if jax.process_count() > 1:
+            # bounded-memory single-writer: stream one split-block per
+            # collective round (see _multiprocess_save_slabs)
+            slabs = _multiprocess_save_slabs(data)
             if jax.process_index() == 0:
                 with h5py.File(path, mode) as handle:
-                    handle.create_dataset(
-                        dataset, shape=data.shape, dtype=np_dtype, data=host_arr, **kwargs
+                    ds = handle.create_dataset(
+                        dataset, shape=data.shape, dtype=np_dtype, **kwargs
                     )
+                    for sl, host in slabs:
+                        ds[sl] = host
+            else:
+                for _ in slabs:  # collective participation, nothing kept
+                    pass
             _sync_processes("heat_tpu.io.save_hdf5")
             return
         with h5py.File(path, mode) as handle:
@@ -308,11 +351,30 @@ if __NETCDF:
             raise ValueError(
                 f"{len(dims)} dimension names given for {data.ndim} dimensions"
             )
-        multi, host_arr = _multiprocess_gather_for_save(data)
+        multi = jax.process_count() > 1
+        trivial = (
+            file_slices == slice(None)
+            or file_slices is Ellipsis
+            or (
+                isinstance(file_slices, tuple)
+                and all(s == slice(None) or s is Ellipsis for s in file_slices)
+            )
+        )
+        host_arr = None
+        if multi and trivial:
+            slabs = _multiprocess_save_slabs(data)  # bounded-memory stream
+        elif multi:
+            # append-region addressing: the caller's target geometry does
+            # not decompose into split-blocks — full gather (whole-array
+            # host memory; appends along an unlimited dim are small)
+            _, host_arr = _multiprocess_gather_for_save(data)
         if multi and jax.process_index() != 0:
-            # the allgather above was the collective part; only process 0
-            # opens the file (plain netCDF4 handles are not multi-writer
-            # safe — reference uses parallel=True, io.py:585)
+            # drain the collective slab stream; only process 0 opens the
+            # file (plain netCDF4 handles are not multi-writer safe —
+            # reference uses parallel=True, io.py:585)
+            if trivial:
+                for _ in slabs:
+                    pass
             _sync_processes("heat_tpu.io.save_netcdf")
             return
         with netCDF4.Dataset(path, mode) as handle:
@@ -323,19 +385,11 @@ if __NETCDF:
                 var = handle.variables[variable]
             else:
                 var = handle.createVariable(variable, np_dtype, tuple(dims), **kwargs)
-            trivial = (
-                file_slices == slice(None)
-                or file_slices is Ellipsis
-                or (
-                    isinstance(file_slices, tuple)
-                    and all(s == slice(None) or s is Ellipsis for s in file_slices)
-                )
-            )
-            if multi:
-                target = file_slices if not trivial else tuple(
-                    slice(0, s) for s in data.shape
-                )
-                var[target] = host_arr
+            if multi and trivial:
+                for sl, host in slabs:
+                    var[sl] = host
+            elif multi:
+                var[file_slices] = host_arr
             elif trivial:
                 # one hyperslab write per device shard, never gathering
                 # (the reference's rank-ordered writes, io.py:366)
@@ -525,19 +579,35 @@ def save_csv(
     decimals: int = -1,
     **kwargs,
 ) -> None:
-    """Save a DNDarray to CSV (reference: io.py:948). Multi-process: the
-    ``numpy()`` allgather is collective, but only process 0 writes the
-    file (single-writer safety, same policy as save_hdf5)."""
+    """Save a DNDarray to CSV (reference: io.py:948). Multi-process:
+    single-writer (process 0) over a bounded slab stream — one
+    split-block allgathered per collective round, never the whole array
+    (same policy as save_hdf5)."""
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, got {type(data)}")
+    fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
+    header = "\n".join(header_lines) if header_lines else ""
+    if jax.process_count() > 1:
+        if data.split not in (None, 0):
+            data = data.resplit(0)  # CSV appends rows; stream row blocks
+        slabs = _multiprocess_save_slabs(data)
+        if jax.process_index() == 0:
+            with open(path, "w") as fh:
+                if header:
+                    fh.write(header + "\n")
+                for _, host in slabs:
+                    if host.ndim == 1:
+                        host = host.reshape(-1, 1)
+                    np.savetxt(fh, host, delimiter=sep, fmt=fmt, comments="")
+        else:
+            for _ in slabs:
+                pass
+        _sync_processes("heat_tpu.io.save_csv")
+        return
     arr = data.numpy()
-    if jax.process_count() == 1 or jax.process_index() == 0:
-        if arr.ndim == 1:
-            arr = arr.reshape(-1, 1)
-        fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
-        header = "\n".join(header_lines) if header_lines else ""
-        np.savetxt(path, arr, delimiter=sep, fmt=fmt, header=header, comments="")
-    _sync_processes("heat_tpu.io.save_csv")
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    np.savetxt(path, arr, delimiter=sep, fmt=fmt, header=header, comments="")
 
 
 def load(path: str, *args, **kwargs) -> DNDarray:

@@ -148,6 +148,7 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
                     jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError,
                     jax.errors.TracerBoolConversionError,
+                    jax.errors.TracerIntegerConversionError,
                 ) as e:
                     raise TypeError(
                         "ht.jit: an op inside the traced function needs the array's "

@@ -527,7 +527,7 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
         phys = _sort_sentinel_fill(a, axis)
         sv, si = parallel.distributed_sort(phys, a.comm.mesh, a.comm.axis_name, axis)
         sv = _padding.mask_phys(sv, a.gshape, axis, 0)
-        si = _padding.mask_phys(si.astype(jnp.int64), a.gshape, axis, 0)
+        si = _padding.mask_phys(si.astype(types.index_jax_type()), a.gshape, axis, 0)
         vals = DNDarray(sv, a.gshape, a.dtype, axis, a.device, a.comm)
         idx = DNDarray(si, a.gshape, types.canonical_heat_type(si.dtype), axis, a.device, a.comm)
         if descending:
@@ -539,19 +539,19 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
         indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
         values = jnp.take_along_axis(arr, indices, axis=axis)
         vals = _wrap(values, a.split, a, dtype=a.dtype)
-        idx = _wrap(indices.astype(jnp.int64), a.split, a)
+        idx = _wrap(indices.astype(types.index_jax_type()), a.split, a)
     else:
         # one lax.sort carrying the iota returns values AND argsort
         # indices together — argsort + take_along_axis costs a second
         # sort-sized gather pass (measured 3.2x the sort floor on v5e)
         arr = a.larray
-        idt = jnp.int32 if arr.shape[axis] < 2**31 else jnp.int64
+        idt = jnp.int32 if arr.shape[axis] < 2**31 else types.index_jax_type()
         iota = jax.lax.broadcasted_iota(idt, arr.shape, axis)
         values, indices = jax.lax.sort(
             (arr, iota), dimension=axis, num_keys=1, is_stable=True
         )
         vals = _wrap(values, a.split, a, dtype=a.dtype)
-        idx = _wrap(indices.astype(jnp.int64), a.split, a)
+        idx = _wrap(indices.astype(types.index_jax_type()), a.split, a)
     if out is not None:
         out.larray = vals.larray
         return out, idx
@@ -684,7 +684,7 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
         gshape = tuple(k if i == dim else s for i, s in enumerate(a.gshape))
         vals = DNDarray(fv, gshape, a.dtype, None, a.device, a.comm)
         idx = DNDarray(
-            fi.astype(jnp.int64), gshape, types.canonical_heat_type(jnp.int64), None, a.device, a.comm
+            fi.astype(types.index_jax_type()), gshape, types.canonical_heat_type(jnp.int64), None, a.device, a.comm
         )
     else:
         arr = a.larray
@@ -697,7 +697,7 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
         values = jnp.moveaxis(values, -1, dim)
         indices = jnp.moveaxis(indices, -1, dim)
         vals = _wrap(values, split, a, dtype=a.dtype)
-        idx = _wrap(indices.astype(jnp.int64), split, a)
+        idx = _wrap(indices.astype(types.index_jax_type()), split, a)
     if out is not None:
         if not isinstance(out, tuple) or len(out) != 2:
             raise TypeError("out must be a (values, indices) tuple of DNDarrays")
@@ -743,10 +743,19 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
             # searchsorted into the small replicated unique set — binary
             # search per element, computed shard-wise under GSPMD (the
             # replicated u needs no collective)
-            inv_phys = jnp.searchsorted(
-                values.astype(phys.dtype), a.larray.reshape(-1)
-            ).astype(jnp.int64)
-            inv = _wrap(jnp.asarray(inv_phys), None, a)
+            q = a.larray.reshape(-1)
+            inv_phys = jnp.searchsorted(values.astype(phys.dtype), q)
+            if jnp.issubdtype(values.dtype, jnp.floating):
+                # NaN queries: searchsorted compares False against
+                # everything and returns len(values), but the unique set
+                # collapses NaNs into ONE slot sorted LAST — remap so the
+                # inverse reconstructs like np.unique's (ADVICE r3)
+                inv_phys = jnp.where(jnp.isnan(q), values.shape[0] - 1, inv_phys)
+            inv_phys = inv_phys.astype(types.index_jax_type())
+            # the inverse is as long as the (flattened) input and computed
+            # shard-wise from it: carry the input's distribution instead
+            # of declaring a replicated wrapper over a sharded buffer
+            inv = _wrap(jnp.asarray(inv_phys), 0 if a.split is not None else None, a)
             return vals, inv
         return vals
     if return_inverse:

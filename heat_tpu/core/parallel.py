@@ -33,6 +33,8 @@ from jax import shard_map
 
 from typing import Callable, Optional, Tuple
 
+from . import types
+
 __all__ = ["halo_exchange", "ring_pairwise", "distributed_sort", "distributed_topk"]
 
 
@@ -419,6 +421,12 @@ def ring_pairwise(
 # full all-gather of the operand ever appears in the HLO.
 
 
+# per-device budget for the balanced gather's (p, cap, ...) intermediate;
+# beyond it the gather runs in bounded rounds (tests shrink this to force
+# the chunked path on small inputs)
+_GATHER_BUDGET_BYTES = 64 << 20
+
+
 def _host_counts(counts: jax.Array) -> np.ndarray:
     """Read the tiny per-shard count vector to the host — the one world
     sync these schedules need (the analog of the reference's size
@@ -469,20 +477,28 @@ def _mask_compact_program(
 
 @functools.lru_cache(maxsize=64)
 def _balanced_gather_program(
-    mesh: Mesh, axis_name: str, cand_blk_shape, cap: int, b_out: int, jdtype: str
+    mesh: Mesh, axis_name: str, cand_blk_shape, cap: int, b_out: int, jdtype: str,
+    chunk: int = 0,
 ):
     """Assemble even split=0 blocks of the compacted stream: all-gather
     the first ``cap`` candidates of every shard (cap = max per-shard
     count ≤ output size) plus the count vector, compute exclusive
     prefixes, and let each output shard take its ``b_out`` rows. The
-    total count arrives as a RUNTIME scalar — only (cap, b_out) shape
-    the program, so the p distinct totals per block size share one
-    compilation."""
+    total count arrives as a RUNTIME scalar — only (cap, b_out, chunk)
+    shape the program, so the p distinct totals per block size share one
+    compilation.
+
+    ``chunk=0`` gathers all ``cap`` candidate rows at once — peak
+    per-device memory (p, cap, ...), fine for sparse selections. For
+    DENSE selections (cap approaching the local block extent) that
+    buffer is ~the whole operand replicated per device, so
+    ``_compact_gather`` switches to ``chunk>0``: the gather runs in
+    ``ceil(cap/chunk)`` rounds of (p, chunk, ...) — same total ICI
+    bytes, bounded live memory."""
     trailing = cand_blk_shape[1:]
     spec_c = P(*((axis_name,) + (None,) * len(trailing)))
 
-    def body(cand_blk, cnt_blk, n_total):
-        allc = lax.all_gather(cand_blk[:cap], axis_name)          # (p, cap, ...)
+    def prefix_index(cnt_blk):
         counts = lax.all_gather(cnt_blk, axis_name).reshape(-1)   # (p,)
         cum = jnp.cumsum(counts)
         r = lax.axis_index(axis_name)
@@ -490,10 +506,43 @@ def _balanced_gather_program(
         q = jnp.searchsorted(cum, g, side="right").astype(jnp.int32)
         qc = jnp.minimum(q, counts.shape[0] - 1)
         li = g - (cum[qc] - counts[qc])
-        flat = allc.reshape((-1,) + trailing)
-        rows_out = flat[jnp.clip(qc * cap + li, 0, flat.shape[0] - 1)]
-        keep = (g < n_total).reshape((-1,) + (1,) * len(trailing))
-        return jnp.where(keep, rows_out, jnp.zeros_like(rows_out))
+        return g, qc, li
+
+    if chunk <= 0 or chunk >= cap:
+        def body(cand_blk, cnt_blk, n_total):
+            g, qc, li = prefix_index(cnt_blk)
+            allc = lax.all_gather(cand_blk[:cap], axis_name)      # (p, cap, ...)
+            flat = allc.reshape((-1,) + trailing)
+            rows_out = flat[jnp.clip(qc * cap + li, 0, flat.shape[0] - 1)]
+            keep = (g < n_total).reshape((-1,) + (1,) * len(trailing))
+            return jnp.where(keep, rows_out, jnp.zeros_like(rows_out))
+    else:
+        rounds = -(-cap // chunk)
+
+        def body(cand_blk, cnt_blk, n_total):
+            g, qc, li = prefix_index(cnt_blk)
+            padded = cand_blk[:cap]
+            if rounds * chunk > cap:
+                pad = jnp.zeros((rounds * chunk - cap,) + trailing, dtype=padded.dtype)
+                padded = jnp.concatenate([padded, pad])
+            out0 = jnp.zeros((b_out,) + trailing, dtype=cand_blk.dtype)
+
+            def round_body(i, out):
+                c0 = i * chunk
+                blkc = lax.dynamic_slice_in_dim(padded, c0, chunk, axis=0)
+                allc = lax.all_gather(blkc, axis_name)            # (p, chunk, ...)
+                flat = allc.reshape((-1,) + trailing)
+                lin = li - c0
+                sel = (lin >= 0) & (lin < chunk)
+                rows = flat[
+                    jnp.clip(qc * chunk + jnp.clip(lin, 0, chunk - 1), 0, flat.shape[0] - 1)
+                ]
+                selb = sel.reshape((-1,) + (1,) * len(trailing))
+                return jnp.where(selb, rows, out)
+
+            out = lax.fori_loop(0, rounds, round_body, out0)
+            keep = (g < n_total).reshape((-1,) + (1,) * len(trailing))
+            return jnp.where(keep, out, jnp.zeros_like(out))
 
     fn = shard_map(
         body, mesh=mesh, in_specs=(spec_c, P(axis_name), P()), out_specs=spec_c,
@@ -513,10 +562,18 @@ def _compact_gather(cand, counts, mesh, axis_name, empty_trailing):
         return jnp.zeros((0,) + tuple(empty_trailing), dtype=cand.dtype), 0
     cap = int(counts_host.max())
     b_out = -(-n_total // p)
+    # bound the gathered intermediate: one-shot all-gather is (p, cap, ...)
+    # per device — for dense selections that is ~the whole operand
+    # replicated. Above the budget, run the gather in rounds of
+    # (p, chunk, ...) instead (same ICI bytes, bounded live memory).
+    row_bytes = max(int(np.prod(cand.shape[1:])), 1) * cand.dtype.itemsize
+    chunk = 0
+    if p * cap * row_bytes > _GATHER_BUDGET_BYTES:
+        chunk = max(_GATHER_BUDGET_BYTES // (p * row_bytes), 1)
     gather = _balanced_gather_program(
         mesh, axis_name,
         tuple(s // p if i == 0 else s for i, s in enumerate(cand.shape)),
-        cap, b_out, np.dtype(cand.dtype).name,
+        cap, b_out, np.dtype(cand.dtype).name, chunk,
     )
     return gather(cand, counts, jnp.int32(n_total)), n_total
 
@@ -568,7 +625,7 @@ def _nonzero_compact_program(mesh: Mesh, axis_name: str, blk_shape, n_split: int
         idx = jnp.nonzero(flat, size=L, fill_value=0)[0]
         coords = list(jnp.unravel_index(idx, blk_shape))
         coords[0] = coords[0] + (r * b0).astype(coords[0].dtype)
-        cand = jnp.stack(coords, axis=1).astype(jnp.int64)  # (L, ndim)
+        cand = jnp.stack(coords, axis=1).astype(types.index_jax_type())  # (L, ndim)
         return cand, c.reshape(1)
 
     fn = shard_map(
@@ -635,9 +692,15 @@ def _local_unique_program(mesh: Mesh, axis_name: str, blk_shape, n_split: int, j
 
 @functools.lru_cache(maxsize=64)
 def _unique_merge_program(mesh: Mesh, axis_name: str, p: int, cap: int, jdtype: str):
-    """Merge the per-shard unique candidate prefixes: all-gather the tiny
-    (p·cap) set, re-sort with validity keys, deduplicate — replicated
-    output (the reference Bcasts its merged set the same way)."""
+    """Merge the per-shard unique candidate prefixes: all-gather the
+    (p·cap) candidate set, re-sort with validity keys, deduplicate —
+    replicated output (the reference Bcasts its merged set the same way).
+
+    Memory note: the merged unique set is REPLICATED by contract (as in
+    the reference), so for inputs whose values are mostly distinct the
+    (p·cap) gather is ~the whole operand per device — that is the
+    output's own footprint, not avoidable by chunking. ``unique`` is a
+    small-alphabet/sparse-result op at scale."""
 
     def body(cand_blk, cnt_blk):
         allc = lax.all_gather(cand_blk[:cap], axis_name).reshape(-1)   # (p*cap,)

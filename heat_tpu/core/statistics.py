@@ -53,11 +53,11 @@ def _argmax_i64(a, axis=None, keepdims=False):
     # module-level (NOT a per-call lambda): the cached-jit layer keys
     # programs on op identity, so a fresh callable per call would
     # retrace+recompile every invocation
-    return jnp.argmax(a, axis=axis, keepdims=keepdims).astype(jnp.int64)
+    return jnp.argmax(a, axis=axis, keepdims=keepdims).astype(types.index_jax_type())
 
 
 def _argmin_i64(a, axis=None, keepdims=False):
-    return jnp.argmin(a, axis=axis, keepdims=keepdims).astype(jnp.int64)
+    return jnp.argmin(a, axis=axis, keepdims=keepdims).astype(types.index_jax_type())
 
 
 def argmax(x: DNDarray, axis: Optional[int] = None, out=None, **kwargs) -> DNDarray:
@@ -179,7 +179,7 @@ def bucketize(input: DNDarray, boundaries, out_int32: bool = False, right: bool 
     # torch semantics: right=False -> x <= boundaries[i] (numpy side='left' is
     # boundaries[i-1] < x), right=True -> boundaries[i-1] <= x < boundaries[i]
     result = jnp.searchsorted(b, input.larray, side="left" if not right else "right")
-    result = result.astype(jnp.int32 if out_int32 else jnp.int64)
+    result = result.astype(jnp.int32 if out_int32 else types.index_jax_type())
     ret = _wrap_reduce(result, input, None, False)
     ret._DNDarray__split = input.split
     if input.split is not None:
@@ -213,7 +213,7 @@ def digitize(x: DNDarray, bins, right: bool = False) -> DNDarray:
     reference: statistics.py digitize)."""
     sanitize_in(x)
     b = bins.larray if isinstance(bins, DNDarray) else jnp.asarray(np.asarray(bins))
-    result = jnp.digitize(x.larray, b, right=right).astype(jnp.int64)
+    result = jnp.digitize(x.larray, b, right=right).astype(types.index_jax_type())
     ret = _wrap_reduce(result, x, None, False)
     if x.split is not None:
         ret._DNDarray__split = x.split
@@ -366,9 +366,22 @@ def percentile(
     axis = sanitize_axis(x.shape, axis)
     if interpolation not in ("linear", "lower", "higher", "midpoint", "nearest"):
         raise ValueError(f"unknown interpolation {interpolation}")
-    q_arr = q.larray if isinstance(q, DNDarray) else jnp.asarray(np.asarray(q, dtype=np.float64))
-    scalar_q = q_arr.ndim == 0
-    qv = np.atleast_1d(np.asarray(q_arr, dtype=np.float64))
+    # q stays a HOST value: the bracketing ranks must be static (they
+    # shape the program), and round-tripping a python float through
+    # jnp.asarray turns it into a tracer under ht.jit (jax inserts a
+    # convert op for the unavailable f64), breaking np.asarray below
+    if isinstance(q, (DNDarray, jax.Array)):
+        q_dev = q.larray if isinstance(q, DNDarray) else q
+        if isinstance(q_dev, jax.core.Tracer):
+            raise TypeError(
+                "percentile: q must be statically known (host value); a "
+                "traced q would make the output shape data-dependent"
+            )
+        q_host = np.asarray(jax.device_get(q_dev), dtype=np.float64)
+    else:
+        q_host = np.asarray(q, dtype=np.float64)
+    scalar_q = q_host.ndim == 0
+    qv = np.atleast_1d(q_host)
     if np.any(qv < 0.0) or np.any(qv > 100.0):
         raise ValueError("percentiles must be in the range [0, 100]")
     eff_axis = axis
@@ -427,7 +440,12 @@ def percentile(
         arr = x.larray
         if types.heat_type_is_exact(x.dtype):
             arr = arr.astype(jnp.float32)
-        result = jnp.percentile(arr, q_arr, axis=axis, method=interpolation, keepdims=keepdims)
+        # q rides in the widest available float (NOT arr.dtype: a bf16 q
+        # would round 99.9 to 100.0 and return the maximum)
+        result = jnp.percentile(
+            arr, jnp.asarray(q_host, dtype=types.wide_jax_type("f")), axis=axis,
+            method=interpolation, keepdims=keepdims,
+        )
     # result has leading q dims when q is a vector
     ret = _wrap_reduce(jnp.asarray(result), x, axis, keepdims) if scalar_q else DNDarray(
         result,

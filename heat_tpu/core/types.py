@@ -310,6 +310,25 @@ def degrade64(t: Type["datatype"]) -> Type["datatype"]:
     return t
 
 
+def index_jax_type():
+    """Physical dtype for index-valued outputs (argmax/argmin/nonzero/
+    sort indices …): ``jnp.int64`` when x64 is live, ``int32`` in degrade
+    mode. Internal code must request THIS instead of ``jnp.int64`` —
+    asking jax for int64 with x64 off truncates anyway and emits a per-op
+    UserWarning, and silencing that globally would also swallow the
+    user's own genuine-truncation warnings (ADVICE r3)."""
+    return jnp.int32 if _DEGRADE_64 else jnp.int64
+
+
+def wide_jax_type(kind: str):
+    """Widest available accumulator dtype of the given kind ('i' or 'f'):
+    64-bit when x64 is live, 32-bit in degrade mode (same rationale as
+    ``index_jax_type``)."""
+    if kind == "i":
+        return jnp.int32 if _DEGRADE_64 else jnp.int64
+    return jnp.float32 if _DEGRADE_64 else jnp.float64
+
+
 def canonical_heat_type(a_type: Union[str, Type[datatype], Any]) -> Type[datatype]:
     """Canonicalize a builtin Python type, type string, numpy/jax dtype or
     heat type into the canonical heat_tpu type (reference: types.py:494).

@@ -38,7 +38,7 @@ __all__ = []
 def _binary_csr_kernel(op_key: str, n1: int, n2: int, m: int, ncols: int, jdtype: str):
     n = n1 + n2
     # linearized keys must not overflow: int64 once m*ncols exceeds int32
-    key_dt = jnp.int64 if m * ncols > np.iinfo(np.int32).max else jnp.int32
+    key_dt = types.wide_jax_type('i') if m * ncols > np.iinfo(np.int32).max else jnp.int32
 
     @jax.jit
     def kernel(cols1, data1, rows1, cols2, data2, rows2):

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI contract — the analog of the reference's test matrix
+# (/root/reference/.github/workflows/ci.yaml:54-56: `mpirun -n 3/4 pytest`).
+#
+# One command reproduces the full evidence:
+#  1. the whole suite on a virtual 8-device CPU mesh (tests/conftest.py
+#     forces JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8),
+#     which includes the REAL 2x2- and 4x1-process Gloo worlds
+#     (tests/test_multiprocess.py) covering ingest, saves, sort,
+#     percentile, ring attention, KMeans, compaction ops, DP + DASO;
+#  2. the multi-chip dryrun: the full training step jit-compiled and
+#     executed on an 8-device mesh (real dp/sp shardings).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m pytest tests/ -q "$@"
+
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): OK')"

@@ -199,6 +199,30 @@ class TestSolver(TestCase):
             x = ht.linalg.cg(A, B, x0)
             np.testing.assert_allclose(x.numpy(), x_expected, atol=1e-3)
 
+    def test_hsvd_rtol_tight_rank_selection(self):
+        # tight rtol must select rank from an EXACT spectrum: the sketch's
+        # power pass weights directions by sigma^3, so a 1e-4*sigma_max
+        # singular value is invisible to it in f32 (ADVICE r3); below
+        # rtol=1e-3 the full-SVD path engages even with a rank budget
+        rng = np.random.default_rng(5)
+        m, n = 512, 128
+        s_true = np.array([1.0, 0.5, 0.2, 1e-4, 5e-5, 2e-5])
+        U, _ = np.linalg.qr(rng.standard_normal((m, 6)))
+        V, _ = np.linalg.qr(rng.standard_normal((n, 6)))
+        a = ((U * s_true) @ V.T).astype(np.float32)
+        a_norm = float(np.linalg.norm(s_true))
+        rtol = 6e-5  # oracle: keep sigma_4=1e-4, discard 5e-5/2e-5 tail
+        for split in (None, 0):
+            A = ht.array(a, split=split)
+            u, sig, v, err = ht.linalg.hsvd_rtol(A, rtol, compute_sv=True, maxrank=8)
+            got = np.asarray(sig.numpy())
+            assert got.shape[0] == 4, f"split={split}: rank {got.shape[0]} != 4"
+            np.testing.assert_allclose(got, s_true[:4], rtol=1e-2, atol=1e-6)
+            assert float(err) <= rtol * 1.5
+            # reconstruction honors the bound
+            rec = (u.numpy() * got) @ v.numpy().T
+            assert np.linalg.norm(rec - a) <= rtol * a_norm * 2
+
     def test_lanczos(self):
         np.random.seed(7)
         n = 12
