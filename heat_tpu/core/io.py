@@ -157,11 +157,15 @@ def _multiprocess_save_slabs(data: DNDarray):
     from jax.experimental import multihost_utils
 
     arr = data._phys
-    if data.dtype is types.bfloat16:
-        arr = arr.astype(jnp.float32)
+    # bf16 upcasts PER SLAB (below) — an up-front astype of the global
+    # array would materialize a full-size f32 copy across HBM, defeating
+    # the bounded-memory point of the streaming
+    cast = data.dtype is types.bfloat16
     split = data.split
     if split is None or arr.is_fully_addressable:
         host = np.asarray(jax.device_get(arr))
+        if cast:
+            host = host.astype(np.float32)
         if host.shape != tuple(data.shape):
             host = host[tuple(slice(0, s) for s in data.shape)]
         yield tuple(slice(0, s) for s in data.shape), host
@@ -176,12 +180,24 @@ def _multiprocess_save_slabs(data: DNDarray):
         idx = [slice(None)] * data.ndim
         idx[split] = slice(start, stop)
         slab = arr[tuple(idx)]  # global slice of the sharded array
+        if cast:
+            slab = slab.astype(jnp.float32)  # one block, bounded
         host = np.asarray(multihost_utils.process_allgather(slab, tiled=True))
         sl = tuple(
             slice(start, stop) if i == split else slice(0, s)
             for i, s in enumerate(data.shape)
         )
         yield sl, host[tuple(slice(0, s.stop - s.start) for s in sl)]
+
+
+def _drain(slab_iter) -> None:
+    """Finish a collective slab stream unconditionally — every process
+    must participate in every per-slab allgather even when the WRITER
+    fails mid-stream (an undrained iterator would leave the other
+    processes blocked inside process_allgather while the writer's
+    exception never propagates)."""
+    for _ in slab_iter:
+        pass
 
 
 def _sync_processes(tag: str) -> None:
@@ -283,15 +299,17 @@ if __HDF5:
             # collective round (see _multiprocess_save_slabs)
             slabs = _multiprocess_save_slabs(data)
             if jax.process_index() == 0:
-                with h5py.File(path, mode) as handle:
-                    ds = handle.create_dataset(
-                        dataset, shape=data.shape, dtype=np_dtype, **kwargs
-                    )
-                    for sl, host in slabs:
-                        ds[sl] = host
+                try:
+                    with h5py.File(path, mode) as handle:
+                        ds = handle.create_dataset(
+                            dataset, shape=data.shape, dtype=np_dtype, **kwargs
+                        )
+                        for sl, host in slabs:
+                            ds[sl] = host
+                finally:
+                    _drain(slabs)  # keep collectives in step on writer error
             else:
-                for _ in slabs:  # collective participation, nothing kept
-                    pass
+                _drain(slabs)  # collective participation, nothing kept
             _sync_processes("heat_tpu.io.save_hdf5")
             return
         with h5py.File(path, mode) as handle:
@@ -373,8 +391,23 @@ if __NETCDF:
             # file (plain netCDF4 handles are not multi-writer safe —
             # reference uses parallel=True, io.py:585)
             if trivial:
-                for _ in slabs:
-                    pass
+                _drain(slabs)
+            _sync_processes("heat_tpu.io.save_netcdf")
+            return
+        if multi and trivial:
+            try:
+                with netCDF4.Dataset(path, mode) as handle:
+                    for i, name in enumerate(dims):
+                        if name not in handle.dimensions:
+                            handle.createDimension(name, None if is_unlimited else data.shape[i])
+                    if variable in handle.variables:
+                        var = handle.variables[variable]
+                    else:
+                        var = handle.createVariable(variable, np_dtype, tuple(dims), **kwargs)
+                    for sl, host in slabs:
+                        var[sl] = host
+            finally:
+                _drain(slabs)  # keep collectives in step on writer error
             _sync_processes("heat_tpu.io.save_netcdf")
             return
         with netCDF4.Dataset(path, mode) as handle:
@@ -385,10 +418,7 @@ if __NETCDF:
                 var = handle.variables[variable]
             else:
                 var = handle.createVariable(variable, np_dtype, tuple(dims), **kwargs)
-            if multi and trivial:
-                for sl, host in slabs:
-                    var[sl] = host
-            elif multi:
+            if multi:
                 var[file_slices] = host_arr
             elif trivial:
                 # one hyperslab write per device shard, never gathering
@@ -592,16 +622,18 @@ def save_csv(
             data = data.resplit(0)  # CSV appends rows; stream row blocks
         slabs = _multiprocess_save_slabs(data)
         if jax.process_index() == 0:
-            with open(path, "w") as fh:
-                if header:
-                    fh.write(header + "\n")
-                for _, host in slabs:
-                    if host.ndim == 1:
-                        host = host.reshape(-1, 1)
-                    np.savetxt(fh, host, delimiter=sep, fmt=fmt, comments="")
+            try:
+                with open(path, "w") as fh:
+                    if header:
+                        fh.write(header + "\n")
+                    for _, host in slabs:
+                        if host.ndim == 1:
+                            host = host.reshape(-1, 1)
+                        np.savetxt(fh, host, delimiter=sep, fmt=fmt, comments="")
+            finally:
+                _drain(slabs)  # keep collectives in step on writer error
         else:
-            for _ in slabs:
-                pass
+            _drain(slabs)
         _sync_processes("heat_tpu.io.save_csv")
         return
     arr = data.numpy()
