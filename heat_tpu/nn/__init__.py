@@ -10,6 +10,7 @@ so e.g. ``ht.nn.Conv`` works without this package re-wrapping every layer.
 from .modules import (
     Module,
     Linear,
+    MultiheadAttention,
     ReLU,
     GELU,
     Tanh,
@@ -36,6 +37,7 @@ from . import functional as F
 __all__ = [
     "Module",
     "Linear",
+    "MultiheadAttention",
     "ReLU",
     "GELU",
     "Tanh",

@@ -31,6 +31,7 @@ from ..core.communication import place as _place
 __all__ = [
     "Module",
     "Linear",
+    "MultiheadAttention",
     "Conv2d",
     "MaxPool2d",
     "AvgPool2d",
@@ -178,6 +179,79 @@ class Conv2d(Module):
         if self.bias:
             y = y + params["bias"][None, :, None, None]
         return y
+
+
+class MultiheadAttention(Module):
+    """Multi-head self-attention — ``torch.nn.MultiheadAttention`` parity
+    (batch_first semantics, self-attention form) for building transformer
+    blocks. The reference has NO attention stack (SURVEY §5); this module
+    completes the model-building story around ``ring_attention``: packed
+    q/k/v projection, per-head split, the SHARED single-device flash path
+    (``attention._single_device_attention`` — splash/flash kernel when
+    the workload fits, blocked program as oracle), merge, output
+    projection. For a sequence-sharded model call
+    ``nn.ring_attention``/``functional.scaled_dot_product_attention`` on
+    DNDarrays directly; inside a jitted train step this module operates
+    on the local (B, S, E) activations like every other layer.
+
+    torch weight mapping (for checkpoint ports):
+    ``in_proj_weight`` (3E, E) → ``params["in_proj"]`` transposed (E, 3E);
+    ``out_proj.weight`` (E, E) → ``params["out_proj"]`` transposed.
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int, bias: bool = True,
+                 causal: bool = False, dtype=jnp.float32):
+        if embed_dim % num_heads != 0:
+            raise ValueError(
+                f"embed_dim ({embed_dim}) must be divisible by num_heads ({num_heads})"
+            )
+        self.embed_dim = int(embed_dim)
+        self.num_heads = int(num_heads)
+        self.head_dim = self.embed_dim // self.num_heads
+        self.bias = bool(bias)
+        self.causal = bool(causal)
+        self.dtype = dtype
+
+    def init(self, key: jax.Array):
+        e = self.embed_dim
+        k_in, k_out = jax.random.split(key)
+        # torch initializes in_proj with xavier_uniform over the (3E, E)
+        # matrix; mirror the same fan computation on the transposed layout
+        bound_in = math.sqrt(6.0 / (e + 3 * e))
+        bound_out = 1.0 / math.sqrt(e)
+        params = {
+            "in_proj": jax.random.uniform(
+                k_in, (e, 3 * e), minval=-bound_in, maxval=bound_in, dtype=self.dtype
+            ),
+            "out_proj": jax.random.uniform(
+                k_out, (e, e), minval=-bound_out, maxval=bound_out, dtype=self.dtype
+            ),
+        }
+        if self.bias:
+            params["in_bias"] = jnp.zeros((3 * e,), dtype=self.dtype)
+            params["out_bias"] = jnp.zeros((e,), dtype=self.dtype)
+        return params
+
+    def apply(self, params, x, *, train: bool = False, key=None):
+        from .functional import scaled_dot_product_attention
+
+        squeeze = x.ndim == 2  # (S, E) unbatched, like torch
+        if squeeze:
+            x = x[None]
+        b, s, e = x.shape
+        h, d = self.num_heads, self.head_dim
+        qkv = x @ params["in_proj"]
+        if self.bias:
+            qkv = qkv + params["in_bias"]
+        # (B, S, 3, H, D) → three (B, H, S, D)
+        qkv = qkv.reshape(b, s, 3, h, d)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+        out = scaled_dot_product_attention(q, k, v, is_causal=self.causal)
+        out = jnp.moveaxis(out, 1, 2).reshape(b, s, e)
+        out = out @ params["out_proj"]
+        if self.bias:
+            out = out + params["out_bias"]
+        return out[0] if squeeze else out
 
 
 class _Pool2d(Module):

@@ -102,6 +102,36 @@ class TestHtJit(TestCase):
         with pytest.raises(TypeError, match="ht.jit"):
             fused(x)
 
+    def test_estimator_predict_under_jit(self):
+        # estimators compose with ht.jit: a fitted model's predict traces
+        # into one program (labels keep their split and values)
+        rng = np.random.default_rng(4)
+        x = ht.array(rng.standard_normal((96, 3)).astype(np.float32), split=0)
+        km = ht.cluster.KMeans(n_clusters=3, init="kmeans++", random_state=0).fit(x)
+        fused_predict = ht.jit(km.predict)
+        out = fused_predict(x)
+        ref = km.predict(x)
+        self.assertEqual(out.split, ref.split)
+        np.testing.assert_array_equal(out.numpy(), ref.numpy())
+
+    def test_preprocessing_pipeline_under_jit(self):
+        rng = np.random.default_rng(5)
+        x = ht.array(rng.standard_normal((64, 6)).astype(np.float32), split=0)
+
+        @ht.jit
+        def pipeline(a):
+            sc = ht.preprocessing.StandardScaler(copy=False)
+            z = sc.fit_transform(a)
+            rb = ht.preprocessing.RobustScaler(copy=False)
+            return rb.fit_transform(z)
+
+        ref = ht.preprocessing.RobustScaler(copy=False).fit_transform(
+            ht.preprocessing.StandardScaler(copy=False).fit_transform(x)
+        )
+        np.testing.assert_allclose(
+            pipeline(x).numpy(), ref.numpy(), rtol=1e-4, atol=1e-5
+        )
+
     def test_mixed_dtypes_and_int_output(self):
         x = ht.random.randn(40, split=0)
 
