@@ -1,0 +1,22 @@
+"""docs/API.md must stay in sync with the actual public surface: the
+test regenerates the index and diffs it against the committed file
+(the analog of the reference's CI-built sphinx autosummary)."""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_reference_is_fresh():
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        import gen_api_docs
+    finally:
+        sys.path.pop(0)
+    with open(os.path.join(ROOT, "docs", "API.md")) as f:
+        committed = f.read()
+    fresh = gen_api_docs.render()
+    assert fresh == committed, (
+        "docs/API.md is stale — regenerate with scripts/gen_api_docs.py"
+    )
