@@ -7,10 +7,12 @@ TPU-native realization of that pattern for attention (Liu et al., Ring
 Attention; the flash-attention online-softmax rescaling makes each ring
 step exact): the SEQUENCE axis is sharded over the mesh, each device
 keeps its Q block stationary, and K/V blocks circulate with
-``lax.ppermute`` over ICI — per step one (Bq × Bk) attention tile rides
-the MXU while the next K/V block is in flight. Memory per device is
-O(S·d / p + Bq·Bk): no device ever holds the full S×S score matrix or
-the full K/V, so sequence length scales with the mesh.
+``lax.ppermute`` over ICI — per step the rotating block is consumed in
+(Bq × chunk) attention tiles on the MXU while the next K/V block is in
+flight. Memory per device is O(S·d / p + Bq·chunk) with chunk ≤ 1024
+(``_RING_INNER_CHUNK``): no device ever holds the full S×S score matrix,
+the full K/V, or even a full (Bq × Bk) block product, so sequence length
+scales with the mesh without the score buffer growing as (S/p)².
 
 Differentiable (scan + ppermute have transpose rules), causal-maskable,
 and pad-safe: logical sequence lengths propagate through the masks so
@@ -53,6 +55,15 @@ def _online_softmax_update(q, k_c, v_c, o, m, l, valid, scale, neg):
     return o, m_new, l
 
 
+# upper bound on the K/V sub-chunk each inner attention tile works on:
+# the per-ring-step score buffer is (..., bq, min(bk, CHUNK)) instead of
+# (..., bq, bk) — the einsum materializes scores over ALL leading
+# batch/head dims at once, so at the 1M-token/64-chip north star
+# (B=1, H=8, bk=16384, bf16) the naive block product is a 4 GB live
+# buffer per step (16 GB in f32); chunked it is 256 MB.
+_RING_INNER_CHUNK = 1024
+
+
 @functools.lru_cache(maxsize=64)
 def _ring_attention_program(
     mesh: Mesh,
@@ -64,9 +75,13 @@ def _ring_attention_program(
     causal: bool,
     scale: float,
     jdtype: str,
+    inner_chunk: int = _RING_INNER_CHUNK,
 ):
     """One jitted shard_map program: stationary Q block, K/V rotating the
-    ring, online-softmax (m, l, o) accumulation per step."""
+    ring, online-softmax (m, l, o) accumulation per step; within a step
+    the rotating block is consumed in ``inner_chunk``-sized tiles (same
+    blocked schedule as the single-device program) so live memory is
+    O(bq·chunk), independent of the per-device block size."""
     p = mesh.devices.size
     spec = P(*(axis_name if i == seq_axis else None for i in range(ndim)))
     neg = jnp.finfo(jnp.dtype(jdtype)).min
@@ -75,6 +90,17 @@ def _ring_attention_program(
         r = lax.axis_index(axis_name)
         bq = q.shape[seq_axis]
         bk = k.shape[seq_axis]
+        chunk = max(1, min(int(inner_chunk), bk))
+        n_inner = -(-bk // chunk)
+        pad_inner = n_inner * chunk - bk
+        if pad_inner:
+            # pad ONCE before the ring; rotations carry the padded block
+            # (bounded overhead: < chunk/bk extra ICI bytes) and the
+            # lidx < bk mask below keeps pad rows out of the softmax
+            widths = [(0, 0)] * ndim
+            widths[-2] = (0, pad_inner)
+            k = jnp.pad(k, widths)
+            v = jnp.pad(v, widths)
         # canonical layout (..., B, D): seq axis at -2 already by caller
         q_pos = (r * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)).astype(jnp.int32)
 
@@ -93,11 +119,25 @@ def _ring_attention_program(
         def step(carry, t):
             k_cur, v_cur, o, m, l = carry
             src = (r + t) % p
-            k_pos = (src * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)).astype(jnp.int32)
-            valid = k_pos < n_kv  # mask K/V pad rows
-            if causal:
-                valid = valid & (k_pos <= q_pos)
-            o, m, l = _online_softmax_update(q, k_cur, v_cur, o, m, l, valid, scale, neg)
+
+            def tile(c2, j):
+                o, m, l = c2
+                k_c = lax.dynamic_slice_in_dim(k_cur, j * chunk, chunk, axis=-2)
+                v_c = lax.dynamic_slice_in_dim(v_cur, j * chunk, chunk, axis=-2)
+                lidx = j * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+                k_pos = (src * bk + lidx).astype(jnp.int32)
+                # lidx < bk masks the inner-chunk pad; k_pos < n_kv the
+                # global sequence pad
+                valid = (lidx < bk) & (k_pos < n_kv)
+                if causal:
+                    valid = valid & (k_pos <= q_pos)
+                o, m, l = _online_softmax_update(q, k_c, v_c, o, m, l, valid, scale, neg)
+                return (o, m, l), None
+
+            if n_inner == 1:
+                (o, m, l), _ = tile((o, m, l), 0)
+            else:
+                (o, m, l), _ = lax.scan(tile, (o, m, l), jnp.arange(n_inner))
             perm = [((i + 1) % p, i) for i in range(p)]
             k_nxt = lax.ppermute(k_cur, axis_name, perm) if p > 1 else k_cur
             v_nxt = lax.ppermute(v_cur, axis_name, perm) if p > 1 else v_cur

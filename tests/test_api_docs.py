@@ -2,18 +2,18 @@
 test regenerates the index and diffs it against the committed file
 (the analog of the reference's CI-built sphinx autosummary)."""
 
+import importlib.util
 import os
-import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_api_reference_is_fresh():
-    sys.path.insert(0, os.path.join(ROOT, "scripts"))
-    try:
-        import gen_api_docs
-    finally:
-        sys.path.pop(0)
+    spec = importlib.util.spec_from_file_location(
+        "gen_api_docs", os.path.join(ROOT, "scripts", "gen_api_docs.py")
+    )
+    gen_api_docs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen_api_docs)
     with open(os.path.join(ROOT, "docs", "API.md")) as f:
         committed = f.read()
     fresh = gen_api_docs.render()
