@@ -143,7 +143,7 @@ def _chained_slope(init, step, sync, k1, k2, reps=5) -> float:
     return _chained_slope_group({"x": (init, step)}, sync, k1, k2, reps)["x"]
 
 
-def _loop_program_time(make_looped, args, sync, k1, k2, reps=5) -> float:
+def _loop_program_time(make_looped, args, sync, k1, k2, reps=7) -> float:
     """Per-iteration device time of a loop-carried body compiled as ONE
     program per loop length: slope between the k1- and k2-iteration
     executables. ``make_looped(k) -> jitted fn(*args)``."""
@@ -188,6 +188,13 @@ def measure_baseline() -> dict:
         u, s, vt = torch.linalg.svd(d, full_matrices=False)
         return u[:, :HSVD_R], s[:HSVD_R]
     out["hsvd"] = _best_of(_hsvd_ref, reps=1)
+
+    # the strongest torch counterpart for the same task: its own
+    # randomized truncated SVD (the reference's hsvd_rank code path uses
+    # the FULL torch.linalg.svd, svdtools.py:477 — both ratios reported)
+    def _hsvd_lowrank():
+        return torch.svd_lowrank(d, q=HSVD_R + 15, niter=1)
+    out["hsvd_lowrank"] = _best_of(_hsvd_lowrank, reps=3)
     del d
 
     x = torch.randn(KM_N, KM_D)
@@ -453,16 +460,39 @@ def measure_heat_tpu() -> dict:
     method["qr"] = "chained-slope"
     del c0
 
-    # hsvd returns (m, r); chain by writing a result-derived value into
-    # one element of the input (cheap at[].set, full dependency)
+    from heat_tpu.core.dndarray import DNDarray
+
+    def _traced_loop_factory(step_of_dnd, meta):
+        """make_looped(k) for _loop_program_time: iterate a traced
+        public-API body (DNDarray in → derived scalar corner-write) k
+        times inside one program. The body must DIGEST every output it
+        cares about (jnp.sum over all result arrays) — a single-element
+        digest lets XLA dead-code-eliminate the rest of the program."""
+        @functools.lru_cache(maxsize=None)
+        def make(k):
+            def body(i, y):
+                d = DNDarray(y, *meta)
+                res = step_of_dnd(d)
+                return y.at[(0,) * y.ndim].set(res * 1e-30)
+            return jax.jit(lambda y: lax.fori_loop(0, k, body, y))
+        return make
+
+    # hsvd cb row feeds the headline vs_baseline: measured as a traced
+    # loop-program (public hsvd_rank, full-output digest) — the chained
+    # form of this 128 MB workload swung 0.013-0.072 s with tunnel
+    # weather, swinging the headline ratio with it
     d = ht.random.random((HSVD_M, HSVD_N), split=0)
-    def _hsvd_step(y):
-        u, err = ht.linalg.hsvd_rank(y, HSVD_R)
-        y[0, 0] = err.larray * 1e-30  # result-derived write, no host sync
-        return y
-    out["hsvd"] = _chained_slope(d, _hsvd_step, sync, k1=4, k2=20)
+
+    def _hsvd_cb_res(dd):
+        u, err = ht.linalg.hsvd_rank(dd, HSVD_R)
+        return jnp.sum(u.larray) + err.larray
+
+    out["hsvd"] = _loop_program_time(
+        _traced_loop_factory(_hsvd_cb_res, (d.shape, d.dtype, d.split, d.device, d.comm)),
+        (d._phys,), sync, k1=4, k2=204,
+    )
     _progress("hsvd", out["hsvd"])
-    method["hsvd"] = "chained-slope"
+    method["hsvd"] = "loop-program (public hsvd_rank traced)"
     del d
 
     from heat_tpu.cluster.kmeans import _lloyd_step
@@ -485,24 +515,10 @@ def measure_heat_tpu() -> dict:
     # stays on device) and iterated k times inside one compiled
     # fori_loop, chained through a corner write. Dispatch cost is
     # reported separately and centrally by the op_chain rows.
-    from heat_tpu.core.dndarray import DNDarray
     from heat_tpu.utils.data.spherical import create_spherical_dataset
     data = create_spherical_dataset(num_samples_cluster=5000, radius=1.0, offset=4.0,
                                     dtype=ht.float32, random_state=1)
     fit_meta = (data.shape, data.dtype, data.split, data.device, data.comm)
-
-    def _traced_loop_factory(step_of_dnd, meta):
-        """make_looped(k) for _loop_program_time: iterate a traced
-        public-API body (DNDarray in → derived scalar corner-write) k
-        times inside one program."""
-        @functools.lru_cache(maxsize=None)
-        def make(k):
-            def body(i, y):
-                d = DNDarray(y, *meta)
-                res = step_of_dnd(d)
-                return y.at[(0,) * y.ndim].set(res * 1e-30)
-            return jax.jit(lambda y: lax.fori_loop(0, k, body, y))
-        return make
 
     def _fit_res(cls, init):
         def run(d):
@@ -690,7 +706,7 @@ def measure_heat_tpu() -> dict:
             return jax.jit(lambda y: lax.fori_loop(0, k, body, y))
         try:
             out["ring_attention_16k_bf16"] = _loop_program_time(
-                _ra_loop, (qkv_big[0]._phys,), sync, k1=4, k2=24
+                _ra_loop, (qkv_big[0]._phys,), sync, k1=4, k2=44
             )
             method["ring_attention_16k_bf16"] = "loop-program (splash kernel)"
             measured = True
@@ -721,7 +737,7 @@ def measure_heat_tpu() -> dict:
             # in-place on the loop carry
             return y.at[0, 0].set(y[0, 0] + err_sq * 1e-30)
         return jax.jit(lambda y: lax.fori_loop(0, k, body, y))
-    out["hsvd_2gb"] = _loop_program_time(_hsvd_loop, (dbig._phys,), sync, k1=2, k2=12)
+    out["hsvd_2gb"] = _loop_program_time(_hsvd_loop, (dbig._phys,), sync, k1=2, k2=22)
     _progress("hsvd_2gb", out["hsvd_2gb"])
     method["hsvd_2gb"] = "loop-program"
     del dbig
@@ -831,6 +847,12 @@ def main() -> None:
     mfu("ring_attention_bf16", ra_flops)
     hbm("sum", SUM_N * 4)
     detail["hsvd"]["gbps"] = round(hsvd_gbps, 2)
+    if base.get("hsvd_lowrank"):
+        # vs torch's own randomized truncated SVD — the fairer algorithmic
+        # peer (the reference's code path is the full SVD above)
+        detail["hsvd"]["speedup_vs_torch_svd_lowrank"] = round(
+            base["hsvd_lowrank"] / ours["hsvd"], 3
+        )
 
     # chip rows
     mfu("matmul_bf16_8k", 2 * MM_8K**3)
@@ -914,7 +936,11 @@ def main() -> None:
         "metric": f"hsvd_rank(r={HSVD_R}) GB/s/chip, {HSVD_BIG_M}x{HSVD_BIG_N} f32 (2.1GB north-star shard)",
         "value": result["value"],
         "unit": "GB/s",
+        # vs_baseline compares the reference's OWN hsvd_rank code path (a
+        # full torch SVD, reference svdtools.py:477); the sketch-vs-sketch
+        # ratio against torch.svd_lowrank sits next to it for fairness
         "vs_baseline": result["vs_baseline"],
+        "vs_torch_svd_lowrank": detail["hsvd"].get("speedup_vs_torch_svd_lowrank"),
         "platform": ours["_meta"]["platform"],
         "key_rows": {
             "matmul_bf16_8k": pick("matmul_bf16_8k", "mfu"),
