@@ -317,16 +317,18 @@ def maximum(x1: DNDarray, x2: DNDarray, out=None) -> DNDarray:
     return _operations.__binary_op(jnp.maximum, x1, x2, out)
 
 
-def mean(x: DNDarray, axis=None) -> DNDarray:
+def mean(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
     """Arithmetic mean (reference: statistics.py:892 — local moments +
-    Allreduce combine; here one sharded jnp.mean)."""
+    Allreduce combine; here one sharded jnp.mean). ``keepdims`` is a
+    numpy-style superset of the reference signature, matching this
+    module's var/std/min/max/median."""
     sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
     arr = x.larray
     if types.heat_type_is_exact(x.dtype):
         arr = arr.astype(jnp.float32)
-    result = jnp.mean(arr, axis=axis)
-    return _wrap_reduce(jnp.asarray(result), x, axis, False)
+    result = jnp.mean(arr, axis=axis, keepdims=bool(keepdims))
+    return _wrap_reduce(jnp.asarray(result), x, axis, bool(keepdims))
 
 
 def median(x: DNDarray, axis: Optional[int] = None, keepdims: bool = False) -> DNDarray:

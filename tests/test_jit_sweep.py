@@ -1,0 +1,102 @@
+"""ht.jit trace-safety sweep: a representative slice of every public-op
+category must produce IDENTICAL results (values, split, dtype) traced
+as eager — this pins the fused-program contract across the surface
+(shape-static ops trace; data-dependent-shape ops raise the documented
+error, covered in test_jit.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def _mk(split):
+    rng = np.random.default_rng(0)
+    return ht.array(rng.standard_normal((13, 6)).astype(np.float32) + 2.0, split=split)
+
+
+UNARY = [
+    ("exp", lambda x: ht.exp(x)),
+    ("log", lambda x: ht.log(ht.abs(x) + 1.0)),
+    ("sqrt-abs", lambda x: ht.sqrt(ht.abs(x))),
+    ("sin-cos", lambda x: ht.sin(x) + ht.cos(x)),
+    ("tanh", lambda x: ht.tanh(x)),
+    ("clip", lambda x: ht.clip(x, -1.0, 1.0)),
+    ("round", lambda x: ht.round(x)),
+    ("floor-ceil", lambda x: ht.floor(x) + ht.ceil(x)),
+    ("sign", lambda x: ht.sign(x)),
+    ("square", lambda x: ht.square(x)),
+]
+
+BINARY = [
+    ("add-mul", lambda x: x + x * 2.0),
+    ("div-sub", lambda x: (x - 1.0) / (ht.abs(x) + 1.0)),
+    ("pow", lambda x: ht.abs(x) ** 1.5),
+    ("minimum-maximum", lambda x: ht.minimum(x, ht.maximum(-x, x * 0.5))),
+    ("where", lambda x: ht.where(x > 2.0, x, -x)),
+    ("relational", lambda x: (x > 2.0).astype(ht.float32) + (x <= 2.0).astype(ht.float32)),
+    ("logical", lambda x: (ht.logical_and(x > 0, x < 4)).astype(ht.float32)),
+]
+
+REDUCTIONS = [
+    ("sum-axis", lambda x: ht.sum(x, axis=0)),
+    ("sum-all", lambda x: ht.sum(x)),
+    ("mean-keepdims", lambda x: ht.mean(x, axis=1, keepdims=True)),
+    ("std-var", lambda x: ht.std(x, axis=0) + ht.var(x, axis=0)),
+    ("min-max", lambda x: ht.min(x, axis=0) + ht.max(x, axis=0)),
+    ("argmax", lambda x: ht.argmax(x, axis=1)),
+    ("prod", lambda x: ht.prod(ht.clip(x, 0.5, 1.5), axis=0)),
+    ("median", lambda x: ht.median(x, axis=0)),
+    ("percentile", lambda x: ht.percentile(x, 30.0, axis=0)),
+    ("norm", lambda x: ht.linalg.norm(x)),
+    ("cumsum", lambda x: ht.cumsum(x, axis=0)),
+]
+
+MANIPULATIONS = [
+    ("reshape", lambda x: ht.reshape(x, (6, 13))),
+    ("transpose", lambda x: ht.transpose(x)),
+    ("flatten", lambda x: ht.flatten(x)),
+    ("concat-self", lambda x: ht.concatenate([x, x], axis=0)),
+    ("stack", lambda x: ht.stack([x, x], axis=0)),
+    ("expand-squeeze", lambda x: ht.squeeze(ht.expand_dims(x, 0), 0)),
+    ("flip", lambda x: ht.flip(x, 0)),
+    ("roll", lambda x: ht.roll(x, 3, 0)),
+    ("split-slice", lambda x: x[2:9, 1:4]),
+    ("sort", lambda x: ht.sort(x, axis=0)[0]),
+    ("topk", lambda x: ht.topk(x.flatten(), 5)[0]),
+    ("resplit", lambda x: x.resplit(1) + 0.0),
+    ("pad", lambda x: ht.pad(x, ((1, 1), (0, 0)))),
+    ("diag-of-gram", lambda x: ht.diag(ht.matmul(ht.transpose(x), x))),
+    ("tril", lambda x: ht.tril(ht.matmul(x, ht.transpose(x)))),
+]
+
+LINALG = [
+    ("matmul", lambda x: ht.matmul(x, ht.transpose(x))),
+    ("vecdot-col", lambda x: ht.matmul(ht.transpose(x), x)),
+    ("qr-q", lambda x: ht.linalg.qr(x.resplit(0))[0]),
+    ("dot-1d", lambda x: ht.dot(x[:, 0], x[:, 1])),
+    ("outer", lambda x: ht.outer(x[:, 0], x[:, 2])),
+]
+
+ALL_CASES = (
+    [("unary-" + n, f) for n, f in UNARY]
+    + [("binary-" + n, f) for n, f in BINARY]
+    + [("reduce-" + n, f) for n, f in REDUCTIONS]
+    + [("manip-" + n, f) for n, f in MANIPULATIONS]
+    + [("linalg-" + n, f) for n, f in LINALG]
+)
+
+
+@pytest.mark.parametrize("name,fn", ALL_CASES, ids=[n for n, _ in ALL_CASES])
+def test_traced_matches_eager(name, fn):
+    for split in (0, None):
+        x = _mk(split)
+        eager = fn(x)
+        traced = ht.jit(fn)(x)
+        assert traced.shape == eager.shape, f"{name} split={split}: shape"
+        assert traced.split == eager.split, f"{name} split={split}: split"
+        assert traced.dtype == eager.dtype, f"{name} split={split}: dtype"
+        np.testing.assert_allclose(
+            traced.numpy(), eager.numpy(), rtol=1e-5, atol=1e-5,
+            err_msg=f"{name} split={split}",
+        )
