@@ -73,6 +73,11 @@ BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_
 # v5e single-chip peaks (per-chip accounting for mfu / hbm_frac)        #
 # --------------------------------------------------------------------- #
 V5E_BF16_FLOPS = 197e12   # MXU peak, bf16 multiply / f32 accumulate
+# ceiling for f32 matmul at DEFAULT precision (bf16 MXU passes + the f32
+# accumulate overhead): consistently measured ~0.78-0.81 of the bf16
+# peak; 165 TF/s is safely above every plausible f32 rate, so a sample
+# past it is weather, not the chip
+V5E_F32_DEFAULT_FLOPS = 165e12
 V5E_HBM_BPS = 819e9       # HBM stream peak
 
 # cb-parity workload sizes (reference cb configurations)
@@ -159,6 +164,21 @@ def _loop_program_time(make_looped, args, sync, k1, k2, reps=7) -> float:
         t2 = time.perf_counter()
         est.append(((t2 - t1) - (t1 - t0)) / (k2 - k1))
     return max(statistics.median(est), 1e-9)
+
+
+def _measure_bounded(thunk, floor_seconds, retries=2):
+    """Run a loop-program measurement with a PHYSICAL floor: a slope
+    below ``floor_seconds`` (the roofline time — bytes/peak or
+    flops/peak) is an under-measurement fabricated by tunnel weather
+    (observed: an "1.8x of HBM peak" hsvd sample), never the chip.
+    Re-measure up to ``retries`` times and keep the slowest estimate —
+    over-measurement only under-reports, which is the safe direction."""
+    t = thunk()
+    for _ in range(retries):
+        if t >= floor_seconds:
+            break
+        t = max(t, thunk())
+    return t
 
 
 def _progress(name, seconds):
@@ -675,9 +695,16 @@ def measure_heat_tpu() -> dict:
 
     am = ht.random.randn(MM_8K, MM_8K, split=0).astype(ht.bfloat16)
     af = ht.random.randn(MM_8K, MM_8K, split=0)
-    out["matmul_bf16_8k"] = _loop_program_time(_mm_loop, (am._phys, am._phys), sync, k1=4, k2=36)
+    mm_floor = 2 * MM_8K**3 / V5E_BF16_FLOPS
+    out["matmul_bf16_8k"] = _measure_bounded(
+        lambda: _loop_program_time(_mm_loop, (am._phys, am._phys), sync, k1=4, k2=36),
+        mm_floor,
+    )
     _progress("matmul_bf16_8k", out["matmul_bf16_8k"])
-    out["matmul_f32_8k"] = _loop_program_time(_mm_loop, (af._phys, af._phys), sync, k1=4, k2=36)
+    out["matmul_f32_8k"] = _measure_bounded(
+        lambda: _loop_program_time(_mm_loop, (af._phys, af._phys), sync, k1=4, k2=36),
+        2 * MM_8K**3 / V5E_F32_DEFAULT_FLOPS,
+    )
     _progress("matmul_f32_8k", out["matmul_f32_8k"])
     method["matmul_bf16_8k"] = method["matmul_f32_8k"] = "loop-program"
     del am, af
@@ -705,8 +732,12 @@ def measure_heat_tpu() -> dict:
                 return kern_run(y, kb, vb).astype(y.dtype)
             return jax.jit(lambda y: lax.fori_loop(0, k, body, y))
         try:
-            out["ring_attention_16k_bf16"] = _loop_program_time(
-                _ra_loop, (qkv_big[0]._phys,), sync, k1=4, k2=44
+            ra_floor = RAB_B * RAB_H * 2 * 2 * RAB_S * RAB_S * RAB_D * 0.5 / V5E_BF16_FLOPS
+            out["ring_attention_16k_bf16"] = _measure_bounded(
+                lambda: _loop_program_time(
+                    _ra_loop, (qkv_big[0]._phys,), sync, k1=4, k2=44
+                ),
+                ra_floor,
             )
             method["ring_attention_16k_bf16"] = "loop-program (splash kernel)"
             measured = True
@@ -737,13 +768,19 @@ def measure_heat_tpu() -> dict:
             # in-place on the loop carry
             return y.at[0, 0].set(y[0, 0] + err_sq * 1e-30)
         return jax.jit(lambda y: lax.fori_loop(0, k, body, y))
-    out["hsvd_2gb"] = _loop_program_time(_hsvd_loop, (dbig._phys,), sync, k1=2, k2=22)
+    out["hsvd_2gb"] = _measure_bounded(
+        lambda: _loop_program_time(_hsvd_loop, (dbig._phys,), sync, k1=2, k2=22),
+        2 * HSVD_BIG_M * HSVD_BIG_N * 4 / V5E_HBM_BPS,  # 2-pass HBM floor
+    )
     _progress("hsvd_2gb", out["hsvd_2gb"])
     method["hsvd_2gb"] = "loop-program"
     del dbig
 
     sb = ht.arange(SUM_BIG_N, dtype=ht.float32, split=0)
-    out["sum_1gb"] = _loop_program_time(_sum_loop, (sb._phys,), sync, k1=4, k2=68)
+    out["sum_1gb"] = _measure_bounded(
+        lambda: _loop_program_time(_sum_loop, (sb._phys,), sync, k1=4, k2=68),
+        SUM_BIG_N * 4 / V5E_HBM_BPS,
+    )
     _progress("sum_1gb", out["sum_1gb"])
     method["sum_1gb"] = "loop-program"
     del sb
@@ -874,17 +911,28 @@ def main() -> None:
     # single-stream utilization, is its honest unit
     detail["sort_1gb"]["melem_per_s"] = round(SORT_BIG_N / ours["sort_1gb"] / 1e6, 1)
 
-    detail["op_chain"]["overhead_vs_raw_jnp"] = round(
-        ours["op_chain"] / ours["op_chain_raw_jnp"], 3
-    )
-    detail["op_chain"]["overhead_vs_fused_jnp"] = round(
-        ours["op_chain"] / ours["op_chain_fused_jnp"], 3
-    )
+    if min(ours["op_chain_raw_jnp"], ours["op_chain_fused_jnp"]) > 1e-8:
+        detail["op_chain"]["overhead_vs_raw_jnp"] = round(
+            ours["op_chain"] / ours["op_chain_raw_jnp"], 3
+        )
+        detail["op_chain"]["overhead_vs_fused_jnp"] = round(
+            ours["op_chain"] / ours["op_chain_fused_jnp"], 3
+        )
+    else:  # clamped denominator: weather ate the signal, don't fabricate
+        detail["op_chain"]["overhead_vs_raw_jnp"] = None
+        detail["op_chain"]["overhead_vs_fused_jnp"] = None
+        detail["op_chain"]["measurement_suspect"] = True
     # the answer to the eager-dispatch gap: the same chain under ht.jit
-    # must track the hand-fused jnp program (≤1.2x)
-    detail["ht_jit_chain"]["overhead_vs_fused_jnp"] = round(
-        ours["ht_jit_chain"] / ours["op_chain_fused_jnp"], 3
-    )
+    # must track the hand-fused jnp program (≤1.2x). A clamped slope on
+    # either side means weather ate the signal — report null, not a
+    # fabricated 0.0x
+    if min(ours["ht_jit_chain"], ours["op_chain_fused_jnp"]) > 1e-8:
+        detail["ht_jit_chain"]["overhead_vs_fused_jnp"] = round(
+            ours["ht_jit_chain"] / ours["op_chain_fused_jnp"], 3
+        )
+    else:
+        detail["ht_jit_chain"]["overhead_vs_fused_jnp"] = None
+        detail["ht_jit_chain"]["measurement_suspect"] = True
     # sanity: one fused program must not lose to a 3-dispatch chain (a
     # violation means the measurement was dispatch/tunnel-bound, not a
     # device-time result — flagged instead of silently reported)
@@ -894,7 +942,11 @@ def main() -> None:
     # roofline credibility: a row above the chip's physical peak means the
     # measurement (not the chip) is wrong — flag it rather than report it
     for row in detail.values():
-        if row.get("mfu", 0) > 1.0 or row.get("hbm_frac", 0) > 1.0:
+        if (
+            row.get("mfu", 0) > 1.0
+            or row.get("hbm_frac", 0) > 1.0
+            or row.get("hbm_frac_algorithmic", 0) > 1.0
+        ):
             row["measurement_suspect"] = True
         # a clamped/zero slope means the row's signal drowned in tunnel
         # noise — flag it instead of reporting an absurd speedup
@@ -943,11 +995,11 @@ def main() -> None:
         "vs_torch_svd_lowrank": detail["hsvd"].get("speedup_vs_torch_svd_lowrank"),
         "platform": ours["_meta"]["platform"],
         "key_rows": {
-            "matmul_bf16_8k": pick("matmul_bf16_8k", "mfu"),
-            "matmul_f32_8k": pick("matmul_f32_8k", "mfu"),
-            "ring_attention_16k_bf16": pick("ring_attention_16k_bf16", "mfu"),
-            "hsvd_2gb": pick("hsvd_2gb", "gbps", "passes_over_A", "hbm_frac_algorithmic"),
-            "sum_1gb": pick("sum_1gb", "hbm_frac"),
+            "matmul_bf16_8k": pick("matmul_bf16_8k", "mfu", "measurement_suspect"),
+            "matmul_f32_8k": pick("matmul_f32_8k", "mfu", "measurement_suspect"),
+            "ring_attention_16k_bf16": pick("ring_attention_16k_bf16", "mfu", "measurement_suspect"),
+            "hsvd_2gb": pick("hsvd_2gb", "gbps", "passes_over_A", "hbm_frac_algorithmic", "measurement_suspect"),
+            "sum_1gb": pick("sum_1gb", "hbm_frac", "measurement_suspect"),
             "sort_1gb": pick("sort_1gb", "melem_per_s"),
             "op_chain": pick("op_chain", "overhead_vs_raw_jnp", "overhead_vs_fused_jnp"),
             "ht_jit_chain": pick("ht_jit_chain", "overhead_vs_fused_jnp") if "ht_jit_chain" in detail else {},
