@@ -49,13 +49,18 @@ Methods:
   ``lax.fori_loop`` with a loop-carried dependency — one dispatch, k
   serial device executions. Per-op time is the slope between a short and
   a long loop, cancelling sync latency, dispatch cost, and cache-lookup
-  constants. Purest device rate; used for the chip rows.
+  constants. Purest device rate; used for the chip rows AND (via the
+  ht.jit tracing machinery, ``_traced_loop_factory``) for every row
+  whose device time sits below the tunnel's ±50 ms noise — the
+  composite fits, lanczos, the scalers, and the 128 MB hsvd row. Loop
+  bodies digest ALL outputs (a single-element digest lets XLA
+  dead-code-eliminate the rest), and chip rows re-measure when a slope
+  lands above the row's physical roofline (``_measure_bounded``).
 * ``chained-slope``: public API calls with each call consuming the
   previous call's output (dispatch cost included — that is what a user
   pays), timed as the same two-point slope, median over reps. Used for
-  the cb rows.
-* ``wallclock``: host-driven composites with internal syncs (full KMeans
-  fit). Plain best-of wall-clock.
+  the cb rows big enough to carry it; the op_chain rows carry the
+  dispatch-cost story centrally.
 """
 
 from __future__ import annotations
