@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from typing import Optional
 
@@ -207,6 +207,268 @@ def _blocked_attention_program(
 _PALLAS_ATTENTION_UNAVAILABLE = False
 _SPLASH_ATTENTION_UNAVAILABLE = False
 
+# tests force Mosaic interpret mode so the kernel ring path runs (slowly)
+# on CPU meshes; production leaves this False and the path is TPU-gated
+_RING_KERNEL_INTERPRET = False
+
+
+def _pick_block(n: int, candidates) -> Optional[int]:
+    """Largest candidate block size that divides n, else None."""
+    return next((c for c in candidates if n % c == 0), None)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_step_kernels(
+    b: int, h: int, bq: int, bk: int, d: int,
+    scale: float, jdtype: str, interpret: bool,
+):
+    """Per-ring-step Pallas kernel pair ``(full_fn, diag_fn)`` for one
+    block signature, or None when no kernel serves it.
+
+    Each fn maps raw (B, H, bq|bk, D) blocks to ``(out, lse)`` where
+    ``out`` is the NORMALIZED attention output of q against that K/V
+    block alone and ``lse`` is its float32 logsumexp — the save-residuals
+    form that lets the ring combine per-step results exactly
+    (o = Σ_i exp(lse_i − LSE)·out_i). ``diag_fn`` applies the causal mask
+    for the block on the ring diagonal (src == r, requires bq == bk);
+    ``full_fn`` is unmasked for blocks strictly behind the query block.
+
+    bf16 → splash kernel (the 0.684-MFU single-device carrier, which
+    computes in bf16 anyway); f32 → the flash kernel via its residual
+    form (keeps f32 exactness, no interpret mode). Build failures are
+    cached as None and the blocked XLA ring stays the fallback/oracle.
+    """
+    jt = jnp.dtype(jdtype)
+    if jt == jnp.bfloat16 or (interpret and jt == jnp.float32):
+        if _SPLASH_ATTENTION_UNAVAILABLE:
+            return None
+        bq_blk = _pick_block(bq, (1024, 512, 256, 128))
+        bkv_blk = _pick_block(bk, (2048, 1024, 512, 256, 128))
+        if bq_blk is None or bkv_blk is None or d % 64 != 0:
+            return None
+        try:
+            full_fn = _build_splash_mha(
+                h, bq, bk, False, scale, bq_blk, bkv_blk, True, interpret
+            )
+            diag_fn = (
+                _build_splash_mha(
+                    h, bq, bq, True, scale, bq_blk, bq_blk, True, interpret
+                )
+                if bq == bk
+                else None
+            )
+        except Exception:
+            return None
+        return (full_fn, diag_fn)
+
+    if jt == jnp.float32 and not interpret:
+        if _PALLAS_ATTENTION_UNAVAILABLE:
+            return None
+        try:
+            import jax.experimental.pallas.ops.tpu.flash_attention as _fa
+        except Exception:
+            return None
+        bq_blk = _pick_block(bq, (1024, 512, 256, 128))
+        bkm = _pick_block(bk, (2048, 1024, 512, 256, 128))
+        bk_blk = _pick_block(bk, (1024, 512, 256, 128))
+        if None in (bq_blk, bkm, bk_blk) or d % 64 != 0:
+            return None
+
+        def build(causal_blk: bool):
+            def run(qa, ka, va):
+                # keyword-bind everything after the arrays: the impl is
+                # underscore-private, and a signature drift must fail
+                # loudly (TypeError → cached None) rather than bind
+                # positionally and compute wrong residuals
+                o, l, m = _fa._flash_attention_impl(
+                    qa, ka, va, None, None,
+                    save_residuals=True, causal=causal_blk,
+                    sm_scale=float(scale), block_b=1, block_q=bq_blk,
+                    block_k_major=bkm, block_k=bk_blk, debug=False,
+                )
+                # full/diag blocks always have ≥1 valid key per row, l > 0
+                return o, (m + jnp.log(l)).astype(jnp.float32)
+
+            return run
+
+        return (build(False), build(True) if bq == bk else None)
+
+    return None
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_attention_kernel_program(
+    mesh: Mesh,
+    axis_name: str,
+    n_q: int,
+    n_kv: int,
+    b: int,
+    h: int,
+    d: int,
+    causal: bool,
+    scale: float,
+    jdtype: str,
+    interpret: bool = False,
+):
+    """Kernel-backed ring attention: the same stationary-Q / rotating-K,V
+    ppermute schedule as ``_ring_attention_program``, but each ring step
+    runs a fused Pallas kernel (splash for bf16, flash for f32) instead of
+    the blocked XLA online-softmax — so sharded-sequence attention keeps
+    kernel-level MFU. The per-step results combine exactly via their
+    logsumexp residuals (f32 accumulator); for causal masks a 3-way
+    ``lax.switch`` schedules each step as skip (block strictly ahead of
+    the queries), diagonal (causal-masked kernel), or full (unmasked).
+
+    Returns None when the signature has no serving kernel (odd blocks,
+    non-divisible shards, unavailable kernel module); callers fall back
+    to the blocked program, which remains the numerical oracle.
+    """
+    p = mesh.devices.size
+    if n_q % p or n_kv % p:
+        return None  # physical pad rows would need masks the kernels lack
+    bq, bk = n_q // p, n_kv // p
+    if causal and bq != bk:
+        return None
+    kernels = _ring_step_kernels(b, h, bq, bk, d, float(scale), jdtype, interpret)
+    if kernels is None:
+        return None
+    full_fn, diag_fn = kernels
+    if causal and diag_fn is None:
+        return None
+    spec = P(None, None, axis_name, None)
+    jt = jnp.dtype(jdtype)
+    neg_inf = jnp.float32(-jnp.inf)
+
+    def body(q, k, v):
+        r = lax.axis_index(axis_name)
+        o0 = jnp.zeros((b, h, bq, d), dtype=jnp.float32)
+        lse0 = jnp.full((b, h, bq), neg_inf, dtype=jnp.float32)
+
+        def step(carry, t):
+            k_cur, v_cur, o, lse = carry
+            src = (r + t) % p
+            if causal:
+                def run_skip(qa, ka, va):
+                    return (
+                        jnp.zeros((b, h, bq, d), dtype=jt),
+                        jnp.full((b, h, bq), neg_inf, dtype=jnp.float32),
+                    )
+
+                def run_diag(qa, ka, va):
+                    return diag_fn(qa, ka, va)
+
+                def run_full(qa, ka, va):
+                    return full_fn(qa, ka, va)
+
+                idx = jnp.where(src == r, 1, jnp.where(src < r, 2, 0))
+                out_i, lse_i = lax.switch(
+                    idx, (run_skip, run_diag, run_full), q, k_cur, v_cur
+                )
+            else:
+                out_i, lse_i = full_fn(q, k_cur, v_cur)
+            lse_new = jnp.logaddexp(lse, lse_i)
+            # both-(-inf) (skip step before any contribution — cannot
+            # happen causally since t=0 is the diagonal, but keep the
+            # combine total): exp(-inf − -inf) would be NaN
+            dead = jnp.isneginf(lse_new)
+            alpha = jnp.where(dead, 0.0, jnp.exp(lse - lse_new))
+            beta = jnp.where(dead, 0.0, jnp.exp(lse_i - lse_new))
+            o = o * alpha[..., None] + out_i.astype(jnp.float32) * beta[..., None]
+            perm = [((i + 1) % p, i) for i in range(p)]
+            k_nxt = lax.ppermute(k_cur, axis_name, perm) if p > 1 else k_cur
+            v_nxt = lax.ppermute(v_cur, axis_name, perm) if p > 1 else v_cur
+            return (k_nxt, v_nxt, o, lse_new), None
+
+        (_, _, o, _), _ = lax.scan(step, (k, v, o0, lse0), jnp.arange(p))
+        return o.astype(jt)
+
+    # check_vma=False: pallas_call outputs carry no varying-mesh-axes
+    # annotation, which the vma checker rejects inside shard_map
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    # AOT-compile against the exact shardings dispatch guarantees (the
+    # DNDarray physical layout) — same rationale as
+    # _pallas_attention_program: a per-signature Mosaic failure surfaces
+    # here, once, and is cached as None; it can never be re-paid at every
+    # ring_attention call
+    sh = NamedSharding(mesh, spec)
+    try:
+        return jax.jit(fn).lower(
+            jax.ShapeDtypeStruct((b, h, n_q, d), jt, sharding=sh),
+            jax.ShapeDtypeStruct((b, h, n_kv, d), jt, sharding=sh),
+            jax.ShapeDtypeStruct((b, h, n_kv, d), jt, sharding=sh),
+        ).compile()
+    except Exception:
+        return None
+
+
+def _ring_kernel_eligible(qp, kp, vp, ndim: int, seq_axis: int, jt) -> bool:
+    """Dispatch gate for the kernel ring: concrete 4-D (B, H, S, D)
+    self-attention-shaped operands on the TPU backend (or interpret mode
+    for tests), matching head dims, x64 off. Shape/divisibility gates
+    live in the program builder, which caches None per signature."""
+    if not (_RING_KERNEL_INTERPRET or jax.default_backend() == "tpu"):
+        return False
+    if jax.config.jax_enable_x64 and not _RING_KERNEL_INTERPRET:
+        # hardware kernels mis-trace under forced x64 (same gate as
+        # _pallas_attention); interpret mode traces cleanly regardless
+        return False
+    if any(isinstance(t, jax.core.Tracer) for t in (qp, kp, vp)):
+        # user jit/grad trace: only the blocked ring is guaranteed
+        # differentiable (the save-residuals combine is forward-only)
+        return False
+    if ndim != 4 or seq_axis != 2:
+        return False
+    if qp.shape[-1] != vp.shape[-1]:
+        return False
+    return jnp.dtype(jt) in (jnp.bfloat16, jnp.float32)
+
+
+def _build_splash_mha(
+    h: int, sq: int, skv: int, causal: bool, scale: float,
+    block_q: int, block_kv: int, save_residuals: bool, interpret: bool,
+):
+    """Shared splash-kernel assembly (mask, BlockSizes, pre-scaled-q vmap
+    wrapper) behind both the single-device callable and the ring step
+    kernels — the splash configuration lives in exactly one place.
+    Splash takes a PRE-SCALED q (no sm_scale parameter). Raises on
+    import/shape failure; callers cache None."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sk,
+        splash_attention_mask as _sm,
+    )
+
+    kv_comp = min(1024, block_kv)
+    bs = _sk.BlockSizes(
+        block_q=block_q, block_kv=block_kv, block_kv_compute=kv_comp,
+        block_q_dkv=block_q, block_kv_dkv=block_kv,
+        block_kv_dkv_compute=kv_comp,
+        block_q_dq=block_q, block_kv_dq=block_kv,
+    )
+    mask = _sm.MultiHeadMask(
+        [
+            _sm.CausalMask((sq, skv)) if causal else _sm.FullMask((sq, skv))
+            for _ in range(h)
+        ]
+    )
+    kern = _sk.make_splash_mha_single_device(
+        mask=mask, block_sizes=bs, save_residuals=save_residuals,
+        interpret=interpret,
+    )
+
+    def run(qa, ka, va):
+        qs = (qa * qa.dtype.type(scale)).astype(qa.dtype)
+        out = jax.vmap(kern)(qs, ka, va)
+        if not save_residuals:
+            return out
+        o, res = out
+        lse = res[0] if isinstance(res, tuple) else res
+        return o, lse.astype(jnp.float32)
+
+    return run
+
 
 @functools.lru_cache(maxsize=64)
 def _splash_callable(q_shape, kv_shape, causal: bool, scale: float, jdtype: str):
@@ -222,14 +484,6 @@ def _splash_callable(q_shape, kv_shape, causal: bool, scale: float, jdtype: str)
     global _SPLASH_ATTENTION_UNAVAILABLE
     if _SPLASH_ATTENTION_UNAVAILABLE:
         return None
-    try:
-        from jax.experimental.pallas.ops.tpu.splash_attention import (
-            splash_attention_kernel as _sk,
-            splash_attention_mask as _sm,
-        )
-    except Exception:
-        _SPLASH_ATTENTION_UNAVAILABLE = True
-        return None
 
     if jnp.dtype(jdtype) != jnp.bfloat16:
         # splash runs its matmuls in bf16 regardless of input dtype
@@ -243,28 +497,13 @@ def _splash_callable(q_shape, kv_shape, causal: bool, scale: float, jdtype: str)
     bkv = 2048 if skv % 2048 == 0 else 1024
     if skv % bkv != 0:
         return None
-    mask = _sm.MultiHeadMask(
-        [
-            _sm.CausalMask((sq, skv)) if causal else _sm.FullMask((sq, skv))
-            for _ in range(h)
-        ]
-    )
-    bkvc = min(1024, bkv)
-    bs = _sk.BlockSizes(
-        block_q=1024, block_kv=bkv, block_kv_compute=bkvc,
-        block_q_dkv=1024, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,
-        block_q_dq=1024, block_kv_dq=bkv,
-    )
     try:
-        kern = _sk.make_splash_mha_single_device(mask=mask, block_sizes=bs)
+        return _build_splash_mha(h, sq, skv, causal, scale, 1024, bkv, False, False)
+    except ImportError:
+        _SPLASH_ATTENTION_UNAVAILABLE = True
+        return None
     except Exception:
         return None
-
-    def run(qa, ka, va):
-        qs = (qa * qa.dtype.type(scale)).astype(qa.dtype)
-        return jax.vmap(kern)(qs, ka, va)
-
-    return run
 
 
 @functools.lru_cache(maxsize=64)
@@ -481,6 +720,19 @@ def ring_attention(
     qp = q._phys.astype(jt) if q.split == seq_axis else comm.shard(q.larray.astype(jt), seq_axis)
     kp = k._phys.astype(jt) if k.split == seq_axis else comm.shard(k.larray.astype(jt), seq_axis)
     vp = v._phys.astype(jt) if v.split == seq_axis else comm.shard(v.larray.astype(jt), seq_axis)
+    if _ring_kernel_eligible(qp, kp, vp, q.ndim, seq_axis, jt):
+        kprog = _ring_attention_kernel_program(
+            comm.mesh, comm.axis_name, q.shape[seq_axis], k.shape[seq_axis],
+            q.shape[0], q.shape[1], q.shape[-1], bool(causal), float(scale),
+            np.dtype(jt).name, _RING_KERNEL_INTERPRET,
+        )
+        if kprog is not None:
+            try:
+                out_phys = kprog(qp, kp, vp)
+            except Exception:
+                out_phys = None  # Mosaic runtime miss the gates can't see
+            if out_phys is not None:
+                return DNDarray(out_phys, out_gshape, dtype, seq_axis, q.device, comm)
     prog = _ring_attention_program(
         comm.mesh, comm.axis_name, q.ndim, seq_axis,
         q.shape[seq_axis], k.shape[seq_axis], bool(causal), float(scale),
@@ -497,3 +749,4 @@ def ring_self_attention(x: DNDarray, causal: bool = False, scale: Optional[float
 
 # programs bake the mesh: clear on init_distributed world rebuilds
 register_mesh_cache(_ring_attention_program)
+register_mesh_cache(_ring_attention_kernel_program)

@@ -432,6 +432,160 @@ class TestRingAttention:
         np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-5)
 
 
+class TestRingKernelAttention:
+    """Kernel-backed ring attention (VERDICT r4 #1): each ring step runs
+    the splash/flash Pallas kernel in save-residuals form and the per-step
+    (out, lse) combine must be EXACT against the blocked-XLA ring oracle.
+    CPU meshes run the kernels in Mosaic interpret mode."""
+
+    B, H, S, D = 1, 2, 1024, 64
+
+    def _mk(self, dtype=np.float32, seed=0):
+        rng = np.random.default_rng(seed)
+        return tuple(
+            rng.standard_normal((self.B, self.H, self.S, self.D)).astype(dtype)
+            for _ in range(3)
+        )
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_kernel_ring_matches_blocked_oracle_p8(self, causal):
+        import heat_tpu.nn.attention as att
+
+        comm = ht.get_comm()
+        scale = float(1 / np.sqrt(self.D))
+        qn, kn, vn = self._mk()
+        q, k, v = (ht.array(x, split=2) for x in (qn, kn, vn))
+        kprog = att._ring_attention_kernel_program(
+            comm.mesh, comm.axis_name, self.S, self.S, self.B, self.H,
+            self.D, causal, scale, "float32", True,
+        )
+        assert kprog is not None
+        out_k = np.asarray(jax.device_get(kprog(q._phys, k._phys, v._phys)))
+        prog = att._ring_attention_program(
+            comm.mesh, comm.axis_name, 4, 2, self.S, self.S, causal,
+            scale, "float32",
+        )
+        out_b = np.asarray(jax.device_get(prog(q._phys, k._phys, v._phys)))
+        np.testing.assert_allclose(out_k, out_b, rtol=2e-5, atol=2e-6)
+
+    @pytest.mark.slow
+    def test_public_dispatch_routes_to_kernel_and_matches_dense(self, monkeypatch):
+        import heat_tpu.nn.attention as att
+
+        monkeypatch.setattr(att, "_RING_KERNEL_INTERPRET", True)
+        calls = []
+        orig = att._ring_attention_kernel_program
+
+        def spy(*a, **kw):
+            r = orig(*a, **kw)
+            calls.append(r is not None)
+            return r
+
+        monkeypatch.setattr(att, "_ring_attention_kernel_program", spy)
+        qn, kn, vn = self._mk(seed=1)
+        q, k, v = (ht.array(x, split=2) for x in (qn, kn, vn))
+        out = ht.nn.ring_attention(q, k, v, causal=True)
+        assert calls == [True], "kernel ring program was not dispatched"
+        assert out.split == 2
+        ref = TestRingAttention._dense(qn, kn, vn, True, 1 / np.sqrt(self.D))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.slow
+    def test_kernel_ring_p1_wrapper_is_exact(self):
+        """Size-1 ring: the wrapper (scan of one step + switch) around the
+        kernel must be numerically invisible — the real-chip bench pins
+        its cost; this pins its numerics."""
+        import heat_tpu.nn.attention as att
+        from jax.sharding import Mesh
+
+        mesh1 = Mesh(np.asarray(jax.devices()[:1]), ("d",))
+        scale = float(1 / np.sqrt(self.D))
+        qn, kn, vn = self._mk(seed=2)
+        kprog = att._ring_attention_kernel_program(
+            mesh1, "d", self.S, self.S, self.B, self.H, self.D, True,
+            scale, "float32", True,
+        )
+        assert kprog is not None
+        out_k = np.asarray(jax.device_get(kprog(*map(jnp.asarray, (qn, kn, vn)))))
+        ref = TestRingAttention._dense(qn, kn, vn, True, scale)
+        np.testing.assert_allclose(out_k, ref, rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.slow
+    def test_kernel_ring_bf16(self):
+        import heat_tpu.nn.attention as att
+
+        comm = ht.get_comm()
+        scale = float(1 / np.sqrt(self.D))
+        qn, kn, vn = self._mk(seed=3)
+        args = tuple(
+            ht.array(x, split=2).astype(ht.bfloat16)._phys for x in (qn, kn, vn)
+        )
+        kprog = att._ring_attention_kernel_program(
+            comm.mesh, comm.axis_name, self.S, self.S, self.B, self.H,
+            self.D, True, scale, "bfloat16", True,
+        )
+        assert kprog is not None
+        out_k = np.asarray(jax.device_get(kprog(*args))).astype(np.float32)
+        ref = TestRingAttention._dense(qn, kn, vn, True, scale)
+        # bf16 storage + bf16 kernel matmuls: ~8-bit mantissa tolerance
+        np.testing.assert_allclose(out_k, ref, rtol=0.06, atol=0.06)
+
+    def test_kernel_ring_hlo_exactly_two_ppermutes(self):
+        """The kernel ring must keep the blocked ring's collective
+        structure: 2 collective-permutes (K and V hops), no all-gather —
+        the ICI-byte term docs/PERF.md charges is unchanged. S is derived
+        from the mesh size so the odd-mesh CI leg exercises it too."""
+        import heat_tpu.nn.attention as att
+
+        comm = ht.get_comm()
+        S = 128 * comm.size  # 128-row shards: smallest splash block
+        scale = float(1 / np.sqrt(self.D))
+        kprog = att._ring_attention_kernel_program(
+            comm.mesh, comm.axis_name, S, S, self.B, self.H,
+            self.D, True, scale, "float32", True,
+        )
+        assert kprog is not None
+        txt = kprog.as_text()
+        n_pp = txt.count(" collective-permute(") + txt.count("collective-permute-start(")
+        assert n_pp == 2, f"kernel ring ppermute count {n_pp} != 2"
+        assert " all-gather(" not in txt and "all-gather-start(" not in txt
+
+    def test_ineligible_signatures_fall_back(self):
+        import heat_tpu.nn.attention as att
+
+        comm = ht.get_comm()
+        # non-divisible global sequence → pad rows the kernels cannot mask
+        assert (
+            att._ring_attention_kernel_program(
+                comm.mesh, comm.axis_name, 1001, 1001, 1, 2, 64, False,
+                0.125, "float32", True,
+            )
+            is None
+        )
+        # causal with mismatched q/kv lengths has no diagonal kernel
+        assert (
+            att._ring_attention_kernel_program(
+                comm.mesh, comm.axis_name, 1024, 2048, 1, 2, 64, True,
+                0.125, "float32", True,
+            )
+            is None
+        )
+        # tracers (user jit/grad) must never take the kernel path, even
+        # when the platform gate is open
+        import unittest.mock as mock
+
+        hit = []
+
+        def probe(x):
+            with mock.patch.object(att, "_RING_KERNEL_INTERPRET", True):
+                hit.append(att._ring_kernel_eligible(x, x, x, 4, 2, jnp.float32))
+            return x
+
+        jax.make_jaxpr(probe)(jnp.zeros((1, 2, 64, 64), jnp.float32))
+        assert hit == [False]
+
+
 class TestPallasAttentionGating:
     """The Mosaic flash kernel is a TPU-only fast path: on any other
     backend the gate must return None (blocked program serves), and a
