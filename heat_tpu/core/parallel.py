@@ -334,6 +334,110 @@ def _oddeven_sort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=64)
+def _columnsort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx_dtype: Optional[str]):
+    """Leighton columnsort along ``split``: the O(1)-collective-round
+    distributed sort (VERDICT r4 #2 — replaces the O(p)-round odd-even
+    schedule at scale).
+
+    The reference's sample sort (manipulations.py:2428) does local sort →
+    splitter election → ONE Alltoallv. Alltoallv's variable counts are
+    data-dependent shapes XLA cannot compile, and sample-sort bucket sizes
+    are adversarially unbounded (sorted input sends a whole shard to one
+    bucket). Columnsort keeps the one-shot-exchange structure with fully
+    STATIC shapes and a determinism guarantee no splitter scheme has:
+
+      1. sort each shard                     (local)
+      2. "deal" rows round-robin to shards   (one tiled ``all_to_all``)
+      3. sort each shard                     (local)
+      4. inverse deal                        (one tiled ``all_to_all``)
+      5. sort each shard                     (local)
+      6-8. boundary cleanup: each shard jointly sorts the half-shard
+           windows it shares with its ring neighbors (two half-shard
+           ``ppermute``s + two local sorts, replacing the shift/unshift
+           columns of the textbook form; ring ends keep their already-
+           sorted halves, so no ±inf fill columns are materialized)
+
+    Total: 2 all-to-alls + 2 half-shard permutes ≈ 3 shard-volumes of ICI
+    bytes and 4 collective rounds, independent of p — vs the odd-even
+    network's p rounds × p shard-volumes. Provably sorted for ANY input
+    when B ≥ 2(p-1)² and p | B (Leighton '85); ``distributed_sort`` gates
+    on exactly that and keeps odd-even as the small-shard fallback.
+
+    Ties: the global pre-sort position rides as a second lexicographic
+    sort key (``num_keys=2``), making every element distinct — the same
+    total order the odd-even program uses, and the argsort contract.
+    """
+    p = mesh.devices.size
+    spec = P(*(axis_name if i == split else None for i in range(ndim)))
+    idt = jnp.dtype(idx_dtype) if idx_dtype is not None else None
+    nk = 2 if idt is not None else 1
+
+    def body(v):
+        rk = lax.axis_index(axis_name)
+        a = jnp.moveaxis(v, split, 0)
+        B = a.shape[0]
+        arrs = [a]
+        if idt is not None:
+            gi = lax.broadcasted_iota(idt, a.shape, 0) + rk.astype(idt) * jnp.asarray(B, idt)
+            arrs.append(gi)
+
+        def srt(ts):
+            return list(lax.sort(tuple(ts), dimension=0, is_stable=True, num_keys=nk))
+
+        def deal(ts):
+            out = []
+            for t in ts:
+                m = t.reshape((B // p, p) + t.shape[1:])
+                m = jnp.moveaxis(m, 1, 0).reshape((B,) + t.shape[1:])
+                out.append(lax.all_to_all(m, axis_name, 0, 0, tiled=True))
+            return out
+
+        def undeal(ts):
+            out = []
+            for t in ts:
+                y = lax.all_to_all(t, axis_name, 0, 0, tiled=True)
+                y = y.reshape((p, B // p) + t.shape[1:])
+                out.append(jnp.moveaxis(y, 0, 1).reshape((B,) + t.shape[1:]))
+            return out
+
+        arrs = srt(arrs)                    # 1: local sort
+        arrs = srt(deal(arrs))              # 2-3: deal + sort
+        arrs = srt(undeal(arrs))            # 4-5: undeal + sort
+        # 6-8: each shard owns final rows [r·B, (r+1)·B); the half-shard
+        # window shared with each neighbor is jointly re-sorted on both
+        # sides (identical input → identical order, no send-back hop)
+        h = B // 2
+        fwd = [(i, i + 1) for i in range(p - 1)]
+        bwd = [(i + 1, i) for i in range(p - 1)]
+        tops = [lax.slice_in_dim(t, 0, B - h, axis=0) for t in arrs]
+        bots = [lax.slice_in_dim(t, B - h, B, axis=0) for t in arrs]
+        recv_prev = [lax.ppermute(t, axis_name, fwd) for t in bots]
+        recv_next = [lax.ppermute(t, axis_name, bwd) for t in tops]
+        sc_own = srt([jnp.concatenate([rp, tp], axis=0) for rp, tp in zip(recv_prev, tops)])
+        sc_next = srt([jnp.concatenate([bt, rn], axis=0) for bt, rn in zip(bots, recv_next)])
+        first, last = rk == 0, rk == p - 1
+        new = []
+        for top, bot, so, sn in zip(tops, bots, sc_own, sc_next):
+            # ring ends: ppermute zero-fills the missing neighbor, so keep
+            # the already-sorted boundary halves verbatim instead
+            up = jnp.where(first, top, lax.slice_in_dim(so, h, B, axis=0))
+            dn = jnp.where(last, bot, lax.slice_in_dim(sn, 0, h, axis=0))
+            new.append(jnp.concatenate([up, dn], axis=0))
+        res = tuple(jnp.moveaxis(t, 0, split) for t in new)
+        return res[0] if idt is None else res
+
+    out_specs = spec if idt is None else (spec, spec)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=out_specs)
+    return jax.jit(fn)
+
+
+def _columnsort_applicable(p: int, B: int) -> bool:
+    """Leighton's validity bound (B ≥ 2(p-1)², p | B) plus profitability:
+    at p ≤ 2 the odd-even network is already ≤ 2 rounds."""
+    return p > 2 and B % p == 0 and B >= 2 * (p - 1) ** 2
+
+
 def distributed_sort(
     phys: jax.Array,
     mesh: Mesh,
@@ -345,6 +449,12 @@ def distributed_sort(
     axis ``split`` without gathering — the explicit-SPMD replacement for
     the reference's sample-sort + Alltoallv (manipulations.py:2428).
 
+    Large shards (B ≥ 2(p-1)², p | B) take the columnsort program — the
+    one-shot-exchange structure of the reference's sample sort with O(1)
+    collective rounds and ~3 shard-volumes of ICI bytes, but fully static
+    shapes; anything smaller falls back to the odd-even block merge-split
+    network (p rounds, provably sorted at any shape).
+
     The caller owns pad semantics: pad rows must already hold a
     maximal sentinel (NaN for floats, type-max for ints) so they sink to
     the global tail — the canonical pad location. Returns physical
@@ -353,6 +463,14 @@ def distributed_sort(
     ``with_indices=False``, returns only values via a program whose
     collectives carry half the volume.
     """
+    p = mesh.devices.size
+    B = -(-phys.shape[split] // p)  # physical rows per shard
+    if _columnsort_applicable(p, B):
+        idx_dtype = None if not with_indices else (
+            "int32" if phys.shape[split] < 2**31 else "int64"
+        )
+        prog = _columnsort_program(mesh, axis_name, phys.ndim, split, idx_dtype)
+        return prog(phys)
     if not with_indices:
         return _oddeven_sort_values_program(mesh, axis_name, phys.ndim, split)(phys)
     idx_dtype = "int32" if phys.shape[split] < 2**31 else "int64"
@@ -750,6 +868,7 @@ register_mesh_cache(_topk_program)
 register_mesh_cache(_ring_program)
 register_mesh_cache(_oddeven_sort_program)
 register_mesh_cache(_oddeven_sort_values_program)
+register_mesh_cache(_columnsort_program)
 register_mesh_cache(_mask_compact_program)
 register_mesh_cache(_balanced_gather_program)
 register_mesh_cache(_nonzero_compact_program)

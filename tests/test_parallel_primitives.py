@@ -268,6 +268,83 @@ class TestDistributedSort:
         assert "collective-permute" in txt
 
 
+class TestColumnsort:
+    """Leighton columnsort (VERDICT r4 #2) — the O(1)-collective-round
+    large-shard sort. Parity must hold on exactly the inputs where a
+    sample sort's splitter scheme degenerates (pre-sorted, constant,
+    few-unique), and the compiled program must show a p-independent
+    collective structure: 2 all-to-alls per operand, 2 half-shard
+    permutes, no rounds growing with p."""
+
+    @pytest.mark.parametrize(
+        "kind", ["random", "sorted", "reverse", "const", "fewuniq"]
+    )
+    def test_matches_numpy_stable_argsort(self, kind):
+        from heat_tpu.core import parallel as par
+
+        n = 4 * P * P * P  # B=4P² ≥ 2(P-1)² and P|B at every mesh size
+        assert par._columnsort_applicable(P, n // P) or P <= 2
+        seeds = {"random": 0, "sorted": 1, "reverse": 2, "const": 3, "fewuniq": 4}
+        rng = np.random.default_rng(seeds[kind])
+        if kind == "random":
+            x = rng.standard_normal(n).astype(np.float32)
+        elif kind == "sorted":
+            x = np.sort(rng.standard_normal(n).astype(np.float32))
+        elif kind == "reverse":
+            x = np.sort(rng.standard_normal(n).astype(np.float32))[::-1].copy()
+        elif kind == "const":
+            x = np.zeros(n, np.float32)
+        else:
+            x = rng.integers(0, 5, n).astype(np.float32)
+        v, i = ht.sort(ht.array(x, split=0))
+        np.testing.assert_array_equal(v.numpy(), np.sort(x, kind="stable"))
+        np.testing.assert_array_equal(i.numpy(), np.argsort(x, kind="stable"))
+
+    def test_uneven_extent_pads_sink(self):
+        n = 4 * P * P * P - 3  # phys pads to B=4P²; sentinels must stay at tail
+        x = np.random.default_rng(5).standard_normal(n).astype(np.float32)
+        v, i = ht.sort(ht.array(x, split=0))
+        np.testing.assert_array_equal(v.numpy(), np.sort(x, kind="stable"))
+        np.testing.assert_array_equal(i.numpy(), np.argsort(x, kind="stable"))
+
+    def test_2d_lanes_large(self):
+        x = np.random.default_rng(6).standard_normal((4 * P * P * P, 4)).astype(np.float32)
+        v, i = ht.sort(ht.array(x, split=0), axis=0)
+        np.testing.assert_array_equal(v.numpy(), np.sort(x, axis=0, kind="stable"))
+        np.testing.assert_array_equal(i.numpy(), np.argsort(x, axis=0, kind="stable"))
+
+    def test_small_shards_fall_back_to_oddeven(self):
+        from heat_tpu.core import parallel as par
+
+        # below Leighton's bound columnsort is invalid; the gate must
+        # route around it (and the result must still be right)
+        assert not par._columnsort_applicable(P, 8)
+        n = 8 * P
+        x = np.random.default_rng(7).standard_normal(n).astype(np.float32)
+        v, _ = ht.sort(ht.array(x, split=0))
+        np.testing.assert_array_equal(v.numpy(), np.sort(x, kind="stable"))
+
+    @pytest.mark.skipif(P <= 2, reason="columnsort gates to p > 2")
+    def test_hlo_constant_collective_rounds(self):
+        # VERDICT r4 #2 done-criterion: O(1) all-to-all rounds instead of
+        # p permute rounds; no gather
+        from heat_tpu.core.parallel import _columnsort_program
+
+        comm = ht.get_comm()
+        for idx_dtype, per_op in (("int32", 2), (None, 1)):
+            prog = _columnsort_program(comm.mesh, comm.axis_name, 1, 0, idx_dtype)
+            phys = comm.shard(jnp.arange(4.0 * P * P * P, dtype=jnp.float32), 0)
+            txt = prog.lower(phys).compile().as_text()
+            n_a2a = txt.count(" all-to-all(") + txt.count("all-to-all-start(")
+            n_pp = txt.count(" collective-permute(") + txt.count(
+                "collective-permute-start("
+            )
+            assert n_a2a == 2 * per_op, f"{idx_dtype}: {n_a2a} all-to-alls"
+            assert n_pp == 2 * per_op, f"{idx_dtype}: {n_pp} ppermutes"
+            assert "all-gather" not in txt
+            assert "all-reduce(" not in txt
+
+
 class TestDistributedPercentile:
     @pytest.mark.parametrize("n", [8 * P, 8 * P - 5])
     @pytest.mark.parametrize("method", ["linear", "lower", "higher", "midpoint", "nearest"])
