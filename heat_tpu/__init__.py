@@ -20,6 +20,13 @@ Usage mirrors the reference::
 # degrade 64-bit dtype requests to 32-bit (the chip has no 64-bit
 # arithmetic). Override explicitly with ``ht.use_x64(True/False)``.
 # See core/devices.py:_apply_x64_policy.
+#
+# Complex dtypes are the same kind of policy: allowed on CPU/GPU, refused
+# AT CREATION TIME with an actionable TypeError on TPU plugins (whose XLA
+# backend rejects complex buffers — and poisons the process on the first
+# enqueued complex op, so there is nothing to degrade to). Override with
+# ``ht.use_complex(True)`` on a TPU runtime that implements complex.
+# See core/devices.py:supports_complex and types.check_complex_platform.
 
 from .core import *
 from .core.linalg import *

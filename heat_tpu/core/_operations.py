@@ -275,6 +275,11 @@ def __binary_op(
     scalar2 = not isinstance(t2, DNDarray)
 
     promoted = types.result_type(t1, t2)
+    # complex platform policy at the PROMOTION point: a real array times a
+    # complex python scalar would otherwise enqueue a complex program
+    # before the output DNDarray's constructor check — and one enqueued
+    # complex op poisons the unsupporting backend for the whole process
+    types.check_complex_platform(types.degrade64(promoted))
     jt = promoted.jax_type()
 
     # non-DNDarray array-likes become concrete arrays up front

@@ -111,6 +111,10 @@ class DNDarray:
         self.__array = array
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = types.degrade64(dtype)
+        # complex platform policy: the ONE choke point every creation
+        # passes through — fail actionably at construction, not with a
+        # raw backend UNIMPLEMENTED at first use (types doc explains)
+        types.check_complex_platform(self.__dtype)
         self.__split = split if split is None else int(split) % max(len(gshape), 1)
         self.__device = device
         self.__comm = comm
@@ -334,6 +338,9 @@ class DNDarray:
         """Cast to ``dtype`` (reference dndarray.py:456). Pad-safe: casts
         preserve zero."""
         dtype = types.canonical_heat_type(dtype)
+        # before the cast is enqueued (complex platform policy; async
+        # transfers surface backend errors at the NEXT sync otherwise)
+        types.check_complex_platform(types.degrade64(dtype))
         casted = self.__array.astype(dtype.jax_type())
         if not copy:
             self.__array = casted

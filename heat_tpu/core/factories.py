@@ -120,6 +120,9 @@ def _create(op_key: str, shape, dtype, split, device, comm, args=()) -> DNDarray
     shape = sanitize_shape(shape)
     split = sanitize_axis(shape, split)
     dtype = types.canonical_heat_type(dtype)
+    # must precede the creator: a complex buffer merely ENQUEUED on an
+    # unsupporting backend poisons the process at the next sync
+    types.check_complex_platform(types.degrade64(dtype))
     creator = _cached_creator(
         comm.mesh,
         comm.axis_name,
@@ -213,6 +216,11 @@ def array(
             dtype = None
     else:
         dtype = types.canonical_heat_type(dtype)
+    if dtype is not None:
+        # before ANY jax op: transfers are async, so an unsupported
+        # complex buffer merely enqueued here would poison the process
+        # at the next sync instead of raising the policy error
+        types.check_complex_platform(types.degrade64(dtype))
 
     if isinstance(obj, jax.Array):
         data = obj
@@ -226,11 +234,13 @@ def array(
         np_data = np.asarray(obj, dtype=np_dtype, order=order)
         if dtype is None:
             dtype = types.canonical_heat_type(np_data.dtype)
+            types.check_complex_platform(types.degrade64(dtype))
             np_data = np_data.astype(np.dtype(dtype.jax_type()), copy=False)
         data = jnp.asarray(np_data)
 
     if dtype is None:
         dtype = types.canonical_heat_type(data.dtype)
+        types.check_complex_platform(types.degrade64(dtype))
 
     # pad dimensions (numpy semantics: prepend)
     if data.ndim < ndmin:

@@ -1,0 +1,84 @@
+"""Complex platform policy (VERDICT r4 #3): complex dtypes are allowed on
+cpu/gpu and refused AT CREATION TIME on TPU plugin backends — whose XLA
+backend has no complex implementation and (measured on the bench chip)
+is left permanently failing by a single enqueued complex op, so there is
+nothing to probe or degrade to. The refusal must be an actionable
+TypeError naming the policy, raised before anything reaches the device,
+from every creation path. Reference parity note: complex_math.py:1-110
+runs on every torch device class; this is the documented deviation
+(docs/MIGRATING.md, 'Complex platform policy').
+
+The refusal mode is platform-independent logic: forced here on the CPU
+suite via ``ht.use_complex(False)`` — the exact state a TPU world boots
+into (devices.supports_complex resolves backend 'tpu' → False)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+@pytest.fixture
+def tpu_complex_policy():
+    """Force the TPU-world complex refusal, restore the CPU default."""
+    ht.use_complex(False)
+    try:
+        yield
+    finally:
+        from heat_tpu.core import devices
+
+        devices._complex_choice = None  # back to platform resolution
+
+
+CREATORS = {
+    "array_np": lambda: ht.array(np.array([1 + 2j, 3 + 4j], np.complex64)),
+    "array_infer": lambda: ht.array([1 + 2j, 3 + 4j]),
+    "array_jax_cast": lambda: ht.array(np.ones(3, np.float32), dtype=ht.complex64),
+    "astype": lambda: ht.arange(4, dtype=ht.float32).astype(ht.complex64),
+    "full_fill": lambda: ht.full((3,), 1j, dtype=ht.complex64),
+    "zeros": lambda: ht.zeros((3,), dtype=ht.complex64),
+    "complex128": lambda: ht.ones((2,), dtype=ht.complex128),
+    "scalar_ctor": lambda: ht.complex64(1 + 1j),
+    # promotion path: real array x complex python scalar promotes to
+    # complex64 INSIDE __binary_op — must refuse at the promotion point,
+    # before the complex program is enqueued (code-review r5 finding)
+    "binary_promotion": lambda: ht.arange(4, dtype=ht.float32) * (1 + 2j),
+}
+
+
+@pytest.mark.parametrize("site", sorted(CREATORS))
+def test_refusal_at_every_creation_site(tpu_complex_policy, site):
+    with pytest.raises(TypeError) as exc:
+        CREATORS[site]()
+    msg = str(exc.value)
+    # actionable: names the dtype family, the reason, and the way out
+    assert "complex" in msg
+    assert "UNIMPLEMENTED" in msg or "backend" in msg
+    assert "MIGRATING" in msg
+
+
+def test_real_dtypes_unaffected(tpu_complex_policy):
+    x = ht.arange(6, dtype=ht.float32, split=0)
+    assert float(x.sum()) == 15.0
+    assert x.astype(ht.bfloat16).dtype is ht.bfloat16
+
+
+def test_cpu_default_allows_complex():
+    """The suite's CPU world must keep full reference complex parity."""
+    assert ht.supports_complex() is True
+    z = ht.array(np.array([1 + 2j, -3 + 4j], np.complex64), split=0)
+    np.testing.assert_allclose(ht.angle(z).numpy(), np.angle([1 + 2j, -3 + 4j]), rtol=1e-6)
+    np.testing.assert_allclose(ht.conj(z).numpy(), np.conj([1 + 2j, -3 + 4j]))
+
+
+def test_use_complex_round_trip():
+    assert ht.use_complex(False) is False
+    try:
+        with pytest.raises(TypeError):
+            ht.zeros((2,), dtype=ht.complex64)
+        assert ht.use_complex(True) is True
+        assert ht.zeros((2,), dtype=ht.complex64).dtype is ht.complex64
+    finally:
+        from heat_tpu.core import devices
+
+        devices._complex_choice = None
