@@ -64,7 +64,6 @@ def _online_softmax_update(q, k_c, v_c, o, m, l, valid, scale, neg):
 _RING_INNER_CHUNK = 1024
 
 
-@functools.lru_cache(maxsize=64)
 def _ring_attention_program(
     mesh: Mesh,
     axis_name: str,
@@ -75,7 +74,31 @@ def _ring_attention_program(
     causal: bool,
     scale: float,
     jdtype: str,
-    inner_chunk: int = _RING_INNER_CHUNK,
+    inner_chunk: Optional[int] = None,
+):
+    """Normalizing entry point for the cached blocked-ring builder: the
+    lru_cache keys on the positional signature, so a defaulted call and
+    an explicit-same-value call would otherwise compile the identical
+    program twice (ADVICE r4). All callers go through here."""
+    return _ring_attention_program_cached(
+        mesh, axis_name, int(ndim), int(seq_axis), int(n_q), int(n_kv),
+        bool(causal), float(scale), str(jdtype),
+        _RING_INNER_CHUNK if inner_chunk is None else int(inner_chunk),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_attention_program_cached(
+    mesh: Mesh,
+    axis_name: str,
+    ndim: int,
+    seq_axis: int,
+    n_q: int,
+    n_kv: int,
+    causal: bool,
+    scale: float,
+    jdtype: str,
+    inner_chunk: int,
 ):
     """One jitted shard_map program: stationary Q block, K/V rotating the
     ring, online-softmax (m, l, o) accumulation per step; within a step
@@ -211,6 +234,12 @@ _SPLASH_ATTENTION_UNAVAILABLE = False
 # on CPU meshes; production leaves this False and the path is TPU-gated
 _RING_KERNEL_INTERPRET = False
 
+# tests force the scan-with-carry ring body (the f32/flash hardware
+# composition) on CPU meshes, where the unrolled body would otherwise be
+# the only one CI ever compiles; build-time flag — clear the builder
+# caches after flipping it
+_RING_KERNEL_FORCE_SCAN = False
+
 
 def _pick_block(n: int, candidates) -> Optional[int]:
     """Largest candidate block size that divides n, else None."""
@@ -297,7 +326,7 @@ def _ring_step_kernels(
 
 
 @functools.lru_cache(maxsize=64)
-def _ring_attention_kernel_program(
+def _ring_attention_kernel_callable(
     mesh: Mesh,
     axis_name: str,
     n_q: int,
@@ -308,20 +337,22 @@ def _ring_attention_kernel_program(
     causal: bool,
     scale: float,
     jdtype: str,
-    interpret: bool = False,
+    interpret: bool,
 ):
-    """Kernel-backed ring attention: the same stationary-Q / rotating-K,V
-    ppermute schedule as ``_ring_attention_program``, but each ring step
-    runs a fused Pallas kernel (splash for bf16, flash for f32) instead of
-    the blocked XLA online-softmax — so sharded-sequence attention keeps
-    kernel-level MFU. The per-step results combine exactly via their
-    logsumexp residuals (f32 accumulator); for causal masks a 3-way
-    ``lax.switch`` schedules each step as skip (block strictly ahead of
-    the queries), diagonal (causal-masked kernel), or full (unmasked).
+    """TRACEABLE shard_map form of the kernel-backed ring attention: the
+    same stationary-Q / rotating-K,V ppermute schedule as
+    ``_ring_attention_program``, but each ring step runs a fused Pallas
+    kernel (splash for bf16, flash for f32) instead of the blocked XLA
+    online-softmax — so sharded-sequence attention keeps kernel-level
+    MFU. The per-step results combine exactly via their logsumexp
+    residuals (f32 accumulator); for causal masks a 3-way ``lax.switch``
+    schedules each step as skip (block strictly ahead of the queries),
+    diagonal (causal-masked kernel), or full (unmasked).
 
     Returns None when the signature has no serving kernel (odd blocks,
-    non-divisible shards, unavailable kernel module); callers fall back
-    to the blocked program, which remains the numerical oracle.
+    non-divisible shards, unavailable kernel module). Dispatch goes
+    through the AOT ``_ring_attention_kernel_program``; bench loops this
+    traceable form inside a fori_loop for the device-rate ring row.
     """
     p = mesh.devices.size
     if n_q % p or n_kv % p:
@@ -338,8 +369,66 @@ def _ring_attention_kernel_program(
     spec = P(None, None, axis_name, None)
     jt = jnp.dtype(jdtype)
     neg_inf = jnp.float32(-jnp.inf)
+    # Composition is gated by kernel family (empirical Mosaic constraint
+    # on this toolchain): the splash kernel compiles under shard_map in
+    # ANY composition, so bf16 takes the faster UNROLLED body; the flash
+    # kernel under shard_map only compiles inside a scan-with-carry
+    # region (direct call, 2/3-branch switch without scan, and scan
+    # without array carry all crash the TPU compile helper), so f32
+    # keeps the scan+switch body.
+    unrolled = (
+        jt == jnp.bfloat16 or (interpret and jt == jnp.float32)
+    ) and not _RING_KERNEL_FORCE_SCAN
 
-    def body(q, k, v):
+    def body_unrolled(q, k, v):
+        # UNROLLED over the (static) ring length: t=0 ASSIGNS the first
+        # kernel result instead of combining against a -inf carry (one
+        # whole output pass saved — measured ~0.4 ms at 16k/p=1, the
+        # bulk of the wrapper overhead vs the bare kernel), the causal
+        # diagonal kernel is chosen statically at t=0 (src == r exactly
+        # when t == 0), and the final wasted K/V rotation is skipped
+        # (p-1 hops, not p). XLA can also pipeline hop t+1 against
+        # kernel t — the overlap the ring schedule exists for.
+        r = lax.axis_index(axis_name)
+        perm = [((i + 1) % p, i) for i in range(p)]
+        k_cur, v_cur = k, v
+        o = lse = None
+        for t in range(p):
+            if t == 0:
+                out_i, lse_i = (diag_fn if causal else full_fn)(q, k_cur, v_cur)
+                o, lse = out_i.astype(jnp.float32), lse_i
+            else:
+                if causal:
+                    # src = (r+t) % p != r here: only full (src strictly
+                    # behind the queries) or skip (strictly ahead)
+                    def run_skip(qa, ka, va):
+                        return (
+                            jnp.zeros((b, h, bq, d), dtype=jt),
+                            jnp.full((b, h, bq), neg_inf, dtype=jnp.float32),
+                        )
+
+                    src = (r + t) % p
+                    out_i, lse_i = lax.switch(
+                        jnp.where(src < r, 1, 0).astype(jnp.int32),
+                        (run_skip, lambda qa, ka, va: full_fn(qa, ka, va)),
+                        q, k_cur, v_cur,
+                    )
+                else:
+                    out_i, lse_i = full_fn(q, k_cur, v_cur)
+                lse_new = jnp.logaddexp(lse, lse_i)
+                # skip steps carry lse_i = -inf; lse is finite from t=0
+                # (causal t=0 is the diagonal), so lse_new stays finite
+                # and exp(lse_i - lse_new) cleanly gives beta = 0
+                alpha = jnp.exp(lse - lse_new)
+                beta = jnp.exp(lse_i - lse_new)
+                o = o * alpha[..., None] + out_i.astype(jnp.float32) * beta[..., None]
+                lse = lse_new
+            if t < p - 1:
+                k_cur = lax.ppermute(k_cur, axis_name, perm)
+                v_cur = lax.ppermute(v_cur, axis_name, perm)
+        return o.astype(jt)
+
+    def body_scan(q, k, v):
         r = lax.axis_index(axis_name)
         o0 = jnp.zeros((b, h, bq, d), dtype=jnp.float32)
         lse0 = jnp.full((b, h, bq), neg_inf, dtype=jnp.float32)
@@ -354,45 +443,64 @@ def _ring_attention_kernel_program(
                         jnp.full((b, h, bq), neg_inf, dtype=jnp.float32),
                     )
 
-                def run_diag(qa, ka, va):
-                    return diag_fn(qa, ka, va)
-
-                def run_full(qa, ka, va):
-                    return full_fn(qa, ka, va)
-
                 idx = jnp.where(src == r, 1, jnp.where(src < r, 2, 0))
                 out_i, lse_i = lax.switch(
-                    idx, (run_skip, run_diag, run_full), q, k_cur, v_cur
+                    idx, (run_skip, diag_fn, full_fn), q, k_cur, v_cur
                 )
             else:
                 out_i, lse_i = full_fn(q, k_cur, v_cur)
             lse_new = jnp.logaddexp(lse, lse_i)
-            # both-(-inf) (skip step before any contribution — cannot
-            # happen causally since t=0 is the diagonal, but keep the
-            # combine total): exp(-inf − -inf) would be NaN
+            # both-(-inf) cannot happen causally (t=0 is the diagonal),
+            # but keep the combine total: exp(-inf − -inf) would be NaN
             dead = jnp.isneginf(lse_new)
             alpha = jnp.where(dead, 0.0, jnp.exp(lse - lse_new))
             beta = jnp.where(dead, 0.0, jnp.exp(lse_i - lse_new))
             o = o * alpha[..., None] + out_i.astype(jnp.float32) * beta[..., None]
-            perm = [((i + 1) % p, i) for i in range(p)]
-            k_nxt = lax.ppermute(k_cur, axis_name, perm) if p > 1 else k_cur
-            v_nxt = lax.ppermute(v_cur, axis_name, perm) if p > 1 else v_cur
+            k_nxt = lax.ppermute(k_cur, axis_name, perm_all) if p > 1 else k_cur
+            v_nxt = lax.ppermute(v_cur, axis_name, perm_all) if p > 1 else v_cur
             return (k_nxt, v_nxt, o, lse_new), None
 
+        perm_all = [((i + 1) % p, i) for i in range(p)]
         (_, _, o, _), _ = lax.scan(step, (k, v, o0, lse0), jnp.arange(p))
         return o.astype(jt)
 
+    body = body_unrolled if unrolled else body_scan
+
     # check_vma=False: pallas_call outputs carry no varying-mesh-axes
     # annotation, which the vma checker rejects inside shard_map
-    fn = shard_map(
+    return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
-    # AOT-compile against the exact shardings dispatch guarantees (the
-    # DNDarray physical layout) — same rationale as
-    # _pallas_attention_program: a per-signature Mosaic failure surfaces
-    # here, once, and is cached as None; it can never be re-paid at every
-    # ring_attention call
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_attention_kernel_program(
+    mesh: Mesh,
+    axis_name: str,
+    n_q: int,
+    n_kv: int,
+    b: int,
+    h: int,
+    d: int,
+    causal: bool,
+    scale: float,
+    jdtype: str,
+    interpret: bool,
+):
+    """AOT-compiled executable of ``_ring_attention_kernel_callable``,
+    lowered against the exact shardings dispatch guarantees (the DNDarray
+    physical layout) — same rationale as ``_pallas_attention_program``: a
+    per-signature Mosaic failure surfaces here, once, and is cached as
+    None; it can never be re-paid at every ring_attention call."""
+    fn = _ring_attention_kernel_callable(
+        mesh, axis_name, n_q, n_kv, b, h, d, causal, scale, jdtype, interpret
+    )
+    if fn is None:
+        return None
+    seq_axis = 2
+    spec = P(*(axis_name if i == seq_axis else None for i in range(4)))
+    jt = jnp.dtype(jdtype)
     sh = NamedSharding(mesh, spec)
     try:
         return jax.jit(fn).lower(
@@ -748,5 +856,6 @@ def ring_self_attention(x: DNDarray, causal: bool = False, scale: Optional[float
 
 
 # programs bake the mesh: clear on init_distributed world rebuilds
-register_mesh_cache(_ring_attention_program)
+register_mesh_cache(_ring_attention_program_cached)
+register_mesh_cache(_ring_attention_kernel_callable)
 register_mesh_cache(_ring_attention_kernel_program)

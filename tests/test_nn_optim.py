@@ -531,11 +531,13 @@ class TestRingKernelAttention:
         # bf16 storage + bf16 kernel matmuls: ~8-bit mantissa tolerance
         np.testing.assert_allclose(out_k, ref, rtol=0.06, atol=0.06)
 
-    def test_kernel_ring_hlo_exactly_two_ppermutes(self):
-        """The kernel ring must keep the blocked ring's collective
-        structure: 2 collective-permutes (K and V hops), no all-gather —
-        the ICI-byte term docs/PERF.md charges is unchanged. S is derived
-        from the mesh size so the odd-mesh CI leg exercises it too."""
+    def test_kernel_ring_hlo_ppermute_structure(self):
+        """The kernel ring is UNROLLED over the static ring length:
+        exactly 2(p-1) collective-permutes — K and V per hop, and the
+        final wasted rotation elided — never an all-gather. Same total
+        ICI bytes as the blocked ring's 2-permute scan, minus one hop.
+        S is derived from the mesh size so the odd-mesh CI leg exercises
+        it too."""
         import heat_tpu.nn.attention as att
 
         comm = ht.get_comm()
@@ -548,8 +550,42 @@ class TestRingKernelAttention:
         assert kprog is not None
         txt = kprog.as_text()
         n_pp = txt.count(" collective-permute(") + txt.count("collective-permute-start(")
-        assert n_pp == 2, f"kernel ring ppermute count {n_pp} != 2"
+        want = 2 * (comm.size - 1)
+        assert n_pp == want, f"kernel ring ppermute count {n_pp} != {want}"
         assert " all-gather(" not in txt and "all-gather-start(" not in txt
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_scan_body_matches_blocked_oracle_p8(self, causal, monkeypatch):
+        """The scan-with-carry ring body — the composition real-TPU f32
+        (flash) dispatch runs, which the unrolled-by-default CPU suite
+        would otherwise never compile — must match the blocked oracle
+        too (code-review r5)."""
+        import heat_tpu.nn.attention as att
+
+        monkeypatch.setattr(att, "_RING_KERNEL_FORCE_SCAN", True)
+        att._ring_attention_kernel_callable.cache_clear()
+        att._ring_attention_kernel_program.cache_clear()
+        try:
+            comm = ht.get_comm()
+            scale = float(1 / np.sqrt(self.D))
+            qn, kn, vn = self._mk(seed=4)
+            q, k, v = (ht.array(x, split=2) for x in (qn, kn, vn))
+            kprog = att._ring_attention_kernel_program(
+                comm.mesh, comm.axis_name, self.S, self.S, self.B, self.H,
+                self.D, causal, scale, "float32", True,
+            )
+            assert kprog is not None
+            out_k = np.asarray(jax.device_get(kprog(q._phys, k._phys, v._phys)))
+            prog = att._ring_attention_program(
+                comm.mesh, comm.axis_name, 4, 2, self.S, self.S, causal,
+                scale, "float32",
+            )
+            out_b = np.asarray(jax.device_get(prog(q._phys, k._phys, v._phys)))
+            np.testing.assert_allclose(out_k, out_b, rtol=2e-5, atol=2e-6)
+        finally:
+            att._ring_attention_kernel_callable.cache_clear()
+            att._ring_attention_kernel_program.cache_clear()
 
     def test_ineligible_signatures_fall_back(self):
         import heat_tpu.nn.attention as att
