@@ -269,7 +269,11 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         split = 0 if x.split is not None else None
         if split is not None:
             labels = x.comm.shard(labels, split)
-        return DNDarray(labels, gshape, types.int64, split, x.device, x.comm)
+        # same index-output dtype convention as _fit_fused / sort / topk
+        return DNDarray(
+            labels, gshape, types.canonical_heat_type(labels.dtype), split,
+            x.device, x.comm,
+        )
 
     @staticmethod
     def _pairwise(arr: jax.Array, c: jax.Array, metric: str = "euclidean") -> jax.Array:
@@ -335,7 +339,14 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         split = 0 if x.split is not None else None
         if split is not None:
             labels = x.comm.shard(labels, split)
-        self._labels = DNDarray(labels, gshape, types.int64, split, x.device, x.comm)
+        # index-output dtype convention (ADVICE r4): like sort/topk/unique
+        # indices, labels declare the PHYSICAL buffer's canonical type —
+        # int64 in x64 mode, int32 under the TPU degrade policy — so
+        # index-valued outputs expose one consistent logical dtype
+        self._labels = DNDarray(
+            labels, gshape, types.canonical_heat_type(labels.dtype), split,
+            x.device, x.comm,
+        )
         return self
 
     def predict(self, x: DNDarray) -> DNDarray:

@@ -596,7 +596,7 @@ def _mask_compact_program(
 @functools.lru_cache(maxsize=64)
 def _balanced_gather_program(
     mesh: Mesh, axis_name: str, cand_blk_shape, cap: int, b_out: int, jdtype: str,
-    chunk: int = 0,
+    chunk: int,
 ):
     """Assemble even split=0 blocks of the compacted stream: all-gather
     the first ``cap`` candidates of every shard (cap = max per-shard

@@ -117,6 +117,20 @@ class AdamW(LocalOptimizer):
 _loss_scalar = scalar_dndarray
 
 
+def _aligned_labels(x: DNDarray, y: DNDarray) -> jax.Array:
+    """Physical labels row-aligned with x's physical batch. A replicated
+    y against a SHARDED x differs in physical extent whenever the batch
+    pads (surfaced by the odd-mesh CI leg: 512 rows over 5 devices pad to
+    515 on the sharded side only) — resplitting y to x.split pads it
+    identically; the pad rows are masked by the step's validity weight.
+    Gated on the EXTENTS, not the splits: when they already match (the
+    common evenly-divisible case) the raw buffer passes through free and
+    jit reshards it inside the step."""
+    if y._phys.shape[0] != x._phys.shape[0]:
+        y = y.resplit(x.split)
+    return y._phys
+
+
 class DataParallelOptimizer:
     """Synchronous data-parallel optimizer (reference dp_optimizer.py:851).
 
@@ -195,7 +209,7 @@ class DataParallelOptimizer:
     def step(self, x: DNDarray, y: DNDarray) -> DNDarray:
         """One fused train step on a global batch; returns the global-mean
         loss as a 0-d replicated DNDarray (no host sync)."""
-        xb, yb = x._phys, y._phys
+        xb, yb = x._phys, _aligned_labels(x, y)
         self._iter += 1
         dropkey = jax.random.fold_in(self._base_key, self._iter)
         fn = self._get_step(
@@ -370,7 +384,7 @@ class DASO:
     def step(self, x: DNDarray, y: DNDarray) -> DNDarray:
         """One DASO step: node-local sync always, global parameter
         averaging every ``global_skip`` batches (reference :202-350)."""
-        xb, yb = x._phys, y._phys
+        xb, yb = x._phys, _aligned_labels(x, y)
         if xb.shape[0] % (self.n_nodes * self.local_size) != 0:
             raise ValueError(
                 f"DASO requires the physical batch ({xb.shape[0]}) divisible by the "

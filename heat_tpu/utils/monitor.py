@@ -12,6 +12,17 @@ profiler. The TPU-native equivalents here:
 - ``trace(path)`` — context manager around ``jax.profiler`` emitting a
   Perfetto/XPlane trace of everything inside (compile, HBM transfers,
   collectives on ICI) for offline analysis in TensorBoard/Perfetto.
+
+Energy (the perun-parity deviation, explicit per VERDICT r4 #8): perun
+reads RAPL/NVML counters on the reference's CPU/GPU hosts. This
+platform exposes NO per-process energy counter — TPU power telemetry
+lives in the cloud monitoring plane (``tpu.googleapis.com`` duty-cycle /
+watts metrics), not in any in-container API, and the jax profiler
+reports time/bytes/FLOPs but not joules. ``@monitor`` therefore records
+runtime only; for energy estimates, multiply device-seconds by the
+chip's published TDP envelope (v5e: ~170-250 W/chip depending on
+workload class) or read the fleet metrics externally. docs/PERF.md
+carries the same note next to the benchmark table.
 """
 
 from __future__ import annotations

