@@ -43,9 +43,13 @@ except Exception:  # pragma: no cover
     pl = None
     _VMEM = None
 
-__all__ = ["sketch_with_norm"]
+__all__ = ["sketch_with_norm", "dual_sketch_with_norm"]
 
 _L_PAD = 32  # sketch-width rows padded to a full sublane multiple
+
+# one-view sketch widths: the co-range sketch ℓ ≈ 2k̂+1 needs more rows
+_L2_PAD = 64   # row-sketch width cap for the dual kernel
+_K_PAD = 32    # column-sketch width cap
 
 
 @functools.lru_cache(maxsize=32)
@@ -95,6 +99,112 @@ def _pick_tile(extent: int, candidates=(1024, 512, 256, 128)) -> int:
         if extent % c == 0:
             return c
     return 0
+
+
+@functools.lru_cache(maxsize=32)
+def _dual_call(m: int, n: int, tm: int, tn: int):
+    """One-view kernel: each (tm × tn) tile of A feeds THREE consumers in
+    a single HBM read — the row sketch ``w += g @ A`` (MXU), the column
+    sketch ``y += A @ Ω`` (MXU), and the Frobenius partial (VPU). This is
+    what makes the single-pass hSVD actually single-pass: XLA lowers the
+    two matmuls as two separate streams over A.
+
+    Residency plan (grid = m outer, n inner; VMEM ≈ 16 MB):
+    - ``y`` block (tm, K_PAD): the canonical accumulator — n is the inner
+      axis, so the block stays resident across its contraction steps;
+    - ``w`` (L2_PAD, n): its contraction axis is m (the OUTER axis), so a
+      tiled block would be revisited non-consecutively and lose its
+      accumulation — instead the WHOLE w lives in VMEM for the entire run
+      (constant block index; ≤ 2 MB at the north-star n=8192) and each
+      step accumulates into its n-tile slice;
+    - the norm tile is the same constant (8, 128) block as sketch_with_norm.
+    """
+    grid = (m // tm, n // tn)
+
+    def kernel(g_ref, om_ref, a_ref, w_ref, y_ref, np_ref):
+        i_m = pl.program_id(0)
+        i_n = pl.program_id(1)
+
+        @pl.when((i_m == 0) & (i_n == 0))
+        def _init_w_norm():
+            w_ref[...] = jnp.zeros_like(w_ref)
+            np_ref[...] = jnp.zeros_like(np_ref)
+
+        @pl.when(i_n == 0)
+        def _init_y():
+            y_ref[...] = jnp.zeros_like(y_ref)
+
+        a = a_ref[...]
+        sl = pl.dslice(i_n * tn, tn)
+        w_ref[:, sl] += jnp.dot(g_ref[...], a, preferred_element_type=jnp.float32)
+        y_ref[...] += jnp.dot(a, om_ref[...], preferred_element_type=jnp.float32)
+        np_ref[...] = np_ref[...] + jnp.sum(a * a)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_L2_PAD, tm), lambda i_m, i_n: (0, i_m), memory_space=_VMEM),
+            pl.BlockSpec((tn, _K_PAD), lambda i_m, i_n: (i_n, 0), memory_space=_VMEM),
+            pl.BlockSpec((tm, tn), lambda i_m, i_n: (i_m, i_n), memory_space=_VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((_L2_PAD, n), lambda i_m, i_n: (0, 0), memory_space=_VMEM),
+            pl.BlockSpec((tm, _K_PAD), lambda i_m, i_n: (i_m, 0), memory_space=_VMEM),
+            pl.BlockSpec((8, 128), lambda i_m, i_n: (0, 0), memory_space=_VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((_L2_PAD, n), jnp.float32),
+            jax.ShapeDtypeStruct((m, _K_PAD), jnp.float32),
+            jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        ],
+    )
+
+
+def dual_sketch_serviceable(l_total: int, k_hat: int, m: int, n: int) -> bool:
+    """Shape-level predicate: would ``dual_sketch_with_norm`` serve this
+    signature ON THE TPU BACKEND? Callers use it to refuse a
+    ``single_pass`` request whose fallback would stream A three times —
+    strictly worse than the 2-pass default the user opted out of."""
+    if l_total > _L2_PAD or k_hat > _K_PAD:
+        return False
+    if _L2_PAD * n * 4 > 4 * 1024 * 1024:
+        return False
+    return bool(_pick_tile(m, (512, 256, 128)) and _pick_tile(n))
+
+
+def dual_sketch_with_norm(g: jax.Array, omega: jax.Array, a: jax.Array):
+    """Fused ``(g @ a, a @ omega, ‖a‖²_F)`` in ONE pass over ``a`` — the
+    one-view (single-pass) hSVD's data movement — or None when the gates
+    don't hold (the caller's XLA formulation is the fallback and the
+    numerical oracle). Traceable; same gate style as sketch_with_norm.
+    ``g``: (ℓ, m) row-sketch operator, ``omega``: (n, k̂) column-sketch
+    operator, ℓ ≤ 64, k̂ ≤ 32."""
+    if pl is None or jax.default_backend() != "tpu" or jax.config.jax_enable_x64:
+        return None
+    if a.dtype != jnp.float32 or g.dtype != jnp.float32 or omega.dtype != jnp.float32:
+        return None
+    if g.ndim != 2 or omega.ndim != 2 or a.ndim != 2:
+        return None
+    if g.shape[1] != a.shape[0] or omega.shape[0] != a.shape[1]:
+        return None
+    l, m = g.shape
+    n, k_hat = omega.shape
+    if l > _L2_PAD or k_hat > _K_PAD:
+        return None
+    # w stays whole in VMEM: bound its footprint (2 MB at n=8192) plus
+    # the tile working set well under the ~16 MB budget
+    if _L2_PAD * n * 4 > 4 * 1024 * 1024:
+        return None
+    tm, tn = _pick_tile(m, (512, 256, 128)), _pick_tile(n)
+    if not tm or not tn:
+        return None
+    g_pad = jnp.pad(g, ((0, _L2_PAD - l), (0, 0))) if l < _L2_PAD else g
+    om_pad = (
+        jnp.pad(omega, ((0, 0), (0, _K_PAD - k_hat))) if k_hat < _K_PAD else omega
+    )
+    w_pad, y_pad, norm_tile = _dual_call(m, n, tm, tn)(g_pad, om_pad, a)
+    return w_pad[:l], y_pad[:, :k_hat], norm_tile[0, 0]
 
 
 def sketch_with_norm(g: jax.Array, a: jax.Array):
