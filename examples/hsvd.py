@@ -53,6 +53,22 @@ def main() -> None:
     )
     ht.print0(f"sigma: {sigma.numpy().round(2)}")
 
+    # one-view variant (r5): reads A exactly ONCE — ~1.7x on TPU for
+    # near-low-rank data (docs/PERF.md documents the quality trade).
+    # NOTE this demo's random matrix is flat-spectrum — OUT of one-view's
+    # domain, so expect a large (and honest) error estimate; the row
+    # demonstrates the throughput, not the approximation.
+    u1, err1 = ht.linalg.hsvd_rank(a, args.rank, single_pass=True)
+    _ = u1.numpy()
+    t0 = time.perf_counter()
+    u1, err1 = ht.linalg.hsvd_rank(a, args.rank, single_pass=True)
+    _ = u1.numpy()
+    dt1 = time.perf_counter() - t0
+    ht.print0(
+        f"hsvd_rank(single_pass=True): {dt1*1000:.1f} ms  "
+        f"({gb/dt1:.1f} GB/s aggregate)  rel-err estimate {float(err1):.3f}"
+    )
+
 
 if __name__ == "__main__":
     main()
