@@ -47,21 +47,73 @@ __all__ = ["qr"]
 QR = collections.namedtuple("QR", "Q, R")
 
 
+def _tsqr_group_size(p: int) -> int:
+    """Group width for the two-level merge: the largest divisor of p not
+    exceeding √p (1 when p is prime — single-level)."""
+    best = 1
+    s = 2
+    while s * s <= p:
+        if p % s == 0:
+            best = s
+        s += 1
+    return best
+
+
+# single-level at small meshes (the merge term is noise there and the HLO
+# contract stays one all-gather); two-level from this width up
+_TSQR_TWO_LEVEL_MIN_P = 16
+
+
 @functools.lru_cache(maxsize=128)
 def _tsqr_fn(mesh, axis_name: str, lrows: int, cols: int, jdtype: str, calc_q: bool):
-    """Compiled TSQR over the mesh for physical shard shape (lrows, cols)."""
+    """Compiled TSQR over the mesh for physical shard shape (lrows, cols).
+
+    p < 16 (or prime p): the flat schedule — ONE all-gather of the p R
+    factors, one stacked merge QR. p ≥ 16 with a divisor s ≤ √p: the
+    TWO-LEVEL tree (docs/PERF.md names the flat merge's (p·r)² growth as
+    the mesh-width wall) — R factors all-gather WITHIN each of the p/s
+    groups (s·K² bytes), each group merges to a group-R, the p/s group-Rs
+    all-gather ACROSS groups (p/s·K² bytes), one final merge: ICI bytes
+    and replicated merge FLOPs drop from p·K² / p·K³ to
+    (s + p/s)·K² / (s + p/s)·K³ — 4× at p=64, 8× at p=256, exactly the
+    point PERF's model said a two-level tree becomes necessary. Q update
+    composes the two tiny block factors: Q = Q_local · Q2[j] · Q3[g]."""
+    p = mesh.devices.size
+    s = _tsqr_group_size(p) if p >= _TSQR_TWO_LEVEL_MIN_P else 1
+    two_level = s > 1
 
     def kernel(a):
         # a: local shard (lrows, cols)
         q1, r1 = jnp.linalg.qr(a, mode="reduced")
-        rs = jax.lax.all_gather(r1, axis_name)  # (p, k, cols), k=min(lrows,cols)
-        rstack = rs.reshape(-1, rs.shape[-1])
-        q2, r = jnp.linalg.qr(rstack, mode="reduced")
+        k = q1.shape[1]
+        if not two_level:
+            rs = jax.lax.all_gather(r1, axis_name)  # (p, k, cols)
+            q2, r = jnp.linalg.qr(rs.reshape(-1, rs.shape[-1]), mode="reduced")
+            if not calc_q:
+                return r
+            i = jax.lax.axis_index(axis_name)
+            q2_i = jax.lax.dynamic_slice_in_dim(q2, i * k, k)
+            return q1 @ q2_i, r
+
+        G = p // s
+        i = jax.lax.axis_index(axis_name)
+        g = i // s   # group id
+        j = i % s    # position within group
+        # level 1: gather the s member R's within each group
+        groups1 = [[gg * s + jj for jj in range(s)] for gg in range(G)]
+        rs1 = jax.lax.all_gather(r1, axis_name, axis_index_groups=groups1)
+        q2, r_g = jnp.linalg.qr(rs1.reshape(-1, rs1.shape[-1]), mode="reduced")
+        k2 = q2.shape[1]
+        # level 2: every group's R_g is replicated within the group, so
+        # gathering across same-j columns hands every device all G of them
+        groups2 = [[gg * s + jj for gg in range(G)] for jj in range(s)]
+        rs2 = jax.lax.all_gather(r_g, axis_name, axis_index_groups=groups2)
+        q3, r = jnp.linalg.qr(rs2.reshape(-1, rs2.shape[-1]), mode="reduced")
         if not calc_q:
             return r
-        i = jax.lax.axis_index(axis_name)
-        q2_i = jax.lax.dynamic_slice_in_dim(q2, i * q1.shape[1], q1.shape[1])
-        return q1 @ q2_i, r
+        q2_j = jax.lax.dynamic_slice_in_dim(q2, j * k, k)
+        q3_g = jax.lax.dynamic_slice_in_dim(q3, g * k2, k2)
+        return q1 @ (q2_j @ q3_g), r
 
     in_specs = PartitionSpec(axis_name, None)
     if calc_q:

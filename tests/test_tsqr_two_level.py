@@ -1,0 +1,67 @@
+"""Two-level TSQR (r5): at mesh widths ≥ 16 the R-factor merge runs as a
+group tree — all-gather WITHIN each √p-wide group, merge, all-gather the
+group R's ACROSS groups, merge — cutting ICI bytes and replicated merge
+FLOPs from p·K² to (s + p/s)·K² (docs/PERF.md named the flat merge's
+(p·r)² growth as the mesh-width wall; this is the promised fix).
+
+The suite's 8-device mesh keeps the flat single-gather schedule (its HLO
+contract is pinned elsewhere), so the two-level path is exercised in a
+SUBPROCESS forcing 16 host devices — the same pattern test_x64_policy
+uses for the degraded mode."""
+
+import os
+import subprocess
+import sys
+
+_WORKER = r"""
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import jax.numpy as jnp
+import heat_tpu as ht
+from heat_tpu.core.linalg.qr import _tsqr_fn, _tsqr_group_size
+
+comm = ht.get_comm()
+assert comm.size == 16, comm.size
+assert _tsqr_group_size(16) == 4
+
+rng = np.random.default_rng(0)
+# QR parity incl. uneven (padded) rows
+for m, n in ((16 * 40, 24), (16 * 33 + 5, 16), (16 * 8, 8)):
+    a = rng.standard_normal((m, n)).astype(np.float32)
+    q, r = ht.linalg.qr(ht.array(a, split=0))
+    qn, rn = q.numpy(), r.numpy()
+    assert np.allclose(qn @ rn, a, atol=1e-4), (m, n)
+    assert np.allclose(qn.T @ qn, np.eye(qn.shape[1]), atol=1e-4), (m, n, 'orth')
+    assert np.allclose(np.triu(rn), rn, atol=1e-5), (m, n, 'upper')
+
+# HLO contract: exactly TWO all-gathers (one per tree level), no other
+# collectives — and each carries s*K^2 / (p/s)*K^2, never the operand
+fn = _tsqr_fn(comm.mesh, comm.axis_name, 40, 24, 'float32', True)
+phys = comm.shard(jnp.ones((16 * 40, 24), jnp.float32), 0)
+txt = fn.lower(phys).compile().as_text()
+n_ag = txt.count(' all-gather(') + txt.count('all-gather-start(')
+assert n_ag == 2, n_ag
+assert ' all-to-all(' not in txt
+assert ' collective-permute(' not in txt
+
+# hSVD merges through the same TSQR: the tree must be invisible to it
+lr = (rng.standard_normal((16 * 24, 6)) @ rng.standard_normal((6, 128))).astype(np.float32)
+u, s, v, err = ht.linalg.hsvd_rank(ht.array(lr, split=0), 8, compute_sv=True)
+rec = (u.numpy() * s.numpy()) @ v.numpy().T
+assert np.linalg.norm(rec - lr) / np.linalg.norm(lr) < 1e-3
+
+print('TSQR_TWO_LEVEL_OK')
+"""
+
+
+def test_two_level_tsqr_subprocess():
+    env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    out = subprocess.run(
+        [sys.executable, "-c", _WORKER], capture_output=True, text=True, env=env,
+        timeout=600,
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "TSQR_TWO_LEVEL_OK" in out.stdout
