@@ -13,8 +13,10 @@ CAQR cites at qr.py:49-58) expressed as ONE ``shard_map``:
     per-shard local QR  →  all_gather of the tiny R factors
     →  merge QR of the stacked R's  →  local Q update (MXU matmul)
 
-One collective (an all-gather of p·n² floats), everything else is local
-MXU work, the whole thing one XLA program. The reference's
+One grouped-all-gather level at small meshes (p·n² floats); composite
+meshes of 16+ devices run a TWO-LEVEL group tree — two grouped
+all-gathers carrying (s + p/s)·n² floats (see ``_tsqr_fn``) — everything
+else is local MXU work, the whole thing one XLA program. The reference's
 ``tiles_per_proc`` knob tuned CPU cache blocking; XLA tiles for the MXU
 itself, so the knob is accepted for API parity and ignored.
 

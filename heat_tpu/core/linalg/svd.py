@@ -3,7 +3,7 @@
 The reference ships only a stub raising toward hSVD
 (/root/reference/heat/core/linalg/svd.py:10). Here ``svd`` is implemented:
 replicated arrays use XLA's SVD directly; tall split=0 matrices factor via
-TSQR (one all-gather on ICI) followed by an SVD of the small R —
+TSQR (the TSQR merge's grouped all-gather(s) on ICI) followed by an SVD of the small R —
 ``A = QR, R = U_R Σ Vᵀ ⇒ U = Q·U_R`` — wide split=1 matrices via the
 transposed identity. A capability the reference directs users away from.
 """

@@ -78,7 +78,7 @@ def _warn_merge_knobs(maxmergedim, no_of_merges) -> None:
 
         warnings.warn(
             "maxmergedim/no_of_merges are accepted for reference-API parity "
-            "but have no effect: the single-level TSQR merge replaces the "
+            "but have no effect: the TSQR merge (flat, or the two-level tree at composite p>=16) replaces the "
             "reference's Send/Recv merge tree",
             UserWarning,
             stacklevel=3,

@@ -478,7 +478,10 @@ class TestCollectiveStructure:
             assert txt.count(f" {op}(") + txt.count(f"{op}-start(") == 0, op
 
     @pytest.mark.skipif(P < 2, reason="needs a real mesh")
-    @pytest.mark.skipif(P >= 16, reason="p >= 16 takes the two-level tree (test_tsqr_two_level)")
+    @pytest.mark.skipif(
+        __import__("heat_tpu.core.linalg.qr", fromlist=["_tsqr_group_size"])._tsqr_group_size(P) > 1 and P >= 16,
+        reason="composite p >= 16 takes the two-level tree (test_tsqr_two_level)",
+    )
     def test_tsqr_single_rfactor_allgather(self):
         import re
 

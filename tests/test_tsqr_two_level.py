@@ -40,10 +40,18 @@ for m, n in ((16 * 40, 24), (16 * 33 + 5, 16), (16 * 8, 8)):
 fn = _tsqr_fn(comm.mesh, comm.axis_name, 40, 24, 'float32', True)
 phys = comm.shard(jnp.ones((16 * 40, 24), jnp.float32), 0)
 txt = fn.lower(phys).compile().as_text()
-n_ag = txt.count(' all-gather(') + txt.count('all-gather-start(')
-assert n_ag == 2, n_ag
+ag_lines = [l for l in txt.splitlines() if ' all-gather(' in l or 'all-gather-start(' in l]
+assert len(ag_lines) == 2, len(ag_lines)
 assert ' all-to-all(' not in txt
 assert ' collective-permute(' not in txt
+# the gathers carry s*K^2 and (p/s)*K^2 floats — never the operand
+import re
+K, s_w, G_w = 24, 4, 4
+sizes = sorted(
+    int(np.prod([int(d) for d in re.search(r'f32\[([\d,]+)\]', l).group(1).split(',')]))
+    for l in ag_lines
+)
+assert sizes == sorted([s_w * K * K, G_w * K * K]), sizes
 
 # hSVD merges through the same TSQR: the tree must be invisible to it
 lr = (rng.standard_normal((16 * 24, 6)) @ rng.standard_normal((6, 128))).astype(np.float32)
