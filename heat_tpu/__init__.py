@@ -21,12 +21,15 @@ Usage mirrors the reference::
 # arithmetic). Override explicitly with ``ht.use_x64(True/False)``.
 # See core/devices.py:_apply_x64_policy.
 #
-# Complex dtypes are the same kind of policy: allowed on CPU/GPU, refused
-# AT CREATION TIME with an actionable TypeError on TPU plugins (whose XLA
-# backend rejects complex buffers — and poisons the process on the first
-# enqueued complex op, so there is nothing to degrade to). Override with
-# ``ht.use_complex(True)`` on a TPU runtime that implements complex.
-# See core/devices.py:supports_complex and types.check_complex_platform.
+# Complex dtypes are the same kind of policy: native on CPU/GPU; on TPU
+# plugins (whose XLA backend rejects complex buffers — and poisons the
+# process on the first enqueued complex op) complex DNDarrays run in
+# PLANAR form — split real/imaginary f32 planes computed by ordinary XLA
+# programs (core/complex_planar.py). Ops outside the documented planar
+# surface raise an actionable TypeError instead of computing wrong
+# results. ``ht.use_complex(True)`` forces native complex (for a TPU
+# runtime that implements it), ``ht.use_complex(False)`` restores the
+# fail-at-creation refusal. See core/devices.py:complex_mode.
 
 from .core import *
 from .core.linalg import *

@@ -278,8 +278,14 @@ def __binary_op(
     # complex platform policy at the PROMOTION point: a real array times a
     # complex python scalar would otherwise enqueue a complex program
     # before the output DNDarray's constructor check — and one enqueued
-    # complex op poisons the unsupporting backend for the whole process
-    types.check_complex_platform(types.degrade64(promoted))
+    # complex op poisons the unsupporting backend for the whole process.
+    # Under the planar policy the whole op routes to plane arithmetic.
+    if types.heat_type_is_complexfloating(types.degrade64(promoted)):
+        from . import complex_planar as _cp
+
+        if _cp.is_planar(t1) or _cp.is_planar(t2) or _cp.active():
+            return _cp.binary(operation, t1, t2, out=out, where=where, fn_kwargs=fn_kwargs)
+        types.check_complex_platform(types.degrade64(promoted))
     jt = promoted.jax_type()
 
     # non-DNDarray array-likes become concrete arrays up front
@@ -402,6 +408,10 @@ def __cum_op(
     from .sanitation import sanitize_in
 
     sanitize_in(x)
+    if isinstance(x, DNDarray) and x._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.cum(operation, x, axis, out=out, dtype=dtype)
     axis = sanitize_axis(x.shape, axis)
     if axis is None:
         raise NotImplementedError("cumulative operation over flattened array: ravel first")
@@ -444,6 +454,10 @@ def __local_op(
     from .sanitation import sanitize_in
 
     sanitize_in(x)
+    if isinstance(x, DNDarray) and x._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.local(operation, x, out, kwargs)
     cast = None
     if not no_cast and types.heat_type_is_exact(x.dtype):
         promoted = types.promote_types(x.dtype, types.float32)
@@ -518,6 +532,10 @@ def __reduce_op(
     from .sanitation import sanitize_in
 
     sanitize_in(x)
+    if isinstance(x, DNDarray) and x._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.reduce(partial_op, x, axis=axis, keepdims=keepdims, out=out, kwargs=kwargs)
     axis = sanitize_axis(x.shape, axis)
 
     kwargs.pop("out", None)

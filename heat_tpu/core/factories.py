@@ -122,7 +122,12 @@ def _create(op_key: str, shape, dtype, split, device, comm, args=()) -> DNDarray
     dtype = types.canonical_heat_type(dtype)
     # must precede the creator: a complex buffer merely ENQUEUED on an
     # unsupporting backend poisons the process at the next sync
-    types.check_complex_platform(types.degrade64(dtype))
+    if types.heat_type_is_complexfloating(types.degrade64(dtype)):
+        from . import complex_planar as _cp
+
+        if _cp.active():
+            return _cp.create(op_key, shape, split, device, comm, args)
+        types.check_complex_platform(types.degrade64(dtype))
     creator = _cached_creator(
         comm.mesh,
         comm.axis_name,
@@ -204,7 +209,14 @@ def array(
     if isinstance(obj, DNDarray):
         if split is None and is_split is None:
             split = obj.split
-        obj = obj.larray
+        if obj._is_planar:
+            # planar complex input: host round-trip (compat path; the
+            # planar factory re-shards the planes)
+            from . import complex_planar as _cp
+
+            obj = _cp.host_complex(obj)
+        else:
+            obj = obj.larray
     if isinstance(obj, (types.datatype,)):
         raise TypeError("cannot create array from a heat type")
 
@@ -216,10 +228,15 @@ def array(
             dtype = None
     else:
         dtype = types.canonical_heat_type(dtype)
-    if dtype is not None:
+    if dtype is not None and types.heat_type_is_complexfloating(types.degrade64(dtype)):
         # before ANY jax op: transfers are async, so an unsupported
         # complex buffer merely enqueued here would poison the process
-        # at the next sync instead of raising the policy error
+        # at the next sync instead of raising the policy error. Under the
+        # planar policy the whole creation routes to plane form.
+        from . import complex_planar as _cp
+
+        if _cp.active():
+            return _cp.array_factory(obj, split, is_split, ndmin, order, device, comm)
         types.check_complex_platform(types.degrade64(dtype))
 
     if isinstance(obj, jax.Array):
@@ -234,12 +251,22 @@ def array(
         np_data = np.asarray(obj, dtype=np_dtype, order=order)
         if dtype is None:
             dtype = types.canonical_heat_type(np_data.dtype)
-            types.check_complex_platform(types.degrade64(dtype))
+            if types.heat_type_is_complexfloating(types.degrade64(dtype)):
+                from . import complex_planar as _cp
+
+                if _cp.active():
+                    return _cp.array_factory(np_data, split, is_split, ndmin, order, device, comm)
+                types.check_complex_platform(types.degrade64(dtype))
             np_data = np_data.astype(np.dtype(dtype.jax_type()), copy=False)
         data = jnp.asarray(np_data)
 
     if dtype is None:
         dtype = types.canonical_heat_type(data.dtype)
+        if types.heat_type_is_complexfloating(types.degrade64(dtype)):
+            from . import complex_planar as _cp
+
+            if _cp.active():
+                return _cp.array_factory(data, split, is_split, ndmin, order, device, comm)
         types.check_complex_platform(types.degrade64(dtype))
 
     # pad dimensions (numpy semantics: prepend)

@@ -96,6 +96,12 @@ def det(a: DNDarray) -> DNDarray:
 def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDarray, float]:
     """Dot product following numpy semantics (reference: basics.py:244)."""
     sanitize_in(a), sanitize_in(b)
+    if a._is_planar or b._is_planar:
+        from .. import complex_planar as _cp
+
+        if out is not None:
+            raise _cp.policy_error("ht.dot with out= on complex operands")
+        return _cp.dot(a, b)
     if a.ndim == 1 and b.ndim == 1:
         # inner product: local mul + sum; all-reduce over split emitted by XLA
         promoted = types.promote_types(a.dtype, b.dtype).jax_type()
@@ -147,6 +153,10 @@ def matmul(
     if a.ndim < 1 or b.ndim < 1:
         raise ValueError("matmul requires at least 1-dimensional operands")
 
+    if a._is_planar or b._is_planar:
+        from .. import complex_planar as _cp
+
+        return _cp.matmul(a, b, precision=precision)
     promoted = types.promote_types(a.dtype, b.dtype)
     arr_a = a.larray.astype(promoted.jax_type())
     arr_b = b.larray.astype(promoted.jax_type())
@@ -229,6 +239,12 @@ def outer(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None, split: Optio
     Bcast ring per rank; the sharded broadcast product is the same
     dataflow)."""
     sanitize_in(a), sanitize_in(b)
+    if a._is_planar or b._is_planar:
+        from .. import complex_planar as _cp
+
+        if out is not None:
+            raise _cp.policy_error("ht.outer with out= on complex operands")
+        return _cp.outer(a, b, split=split)
     promoted = types.promote_types(a.dtype, b.dtype).jax_type()
     result = jnp.outer(a.larray.astype(promoted), b.larray.astype(promoted))
     if split is None:
@@ -281,6 +297,10 @@ def transpose(a: DNDarray, axes: Optional[List[int]] = None) -> DNDarray:
         axes = tuple(sanitize_axis(a.shape, int(ax)) for ax in axes)
         if sorted(axes) != list(range(a.ndim)):
             raise ValueError(f"axes do not match array dimensions, got {axes}")
+    if a._is_planar:
+        from .. import complex_planar as _cp
+
+        return _cp.transpose(a, axes)
     result = jnp.transpose(a.larray, axes)
     split = axes.index(a.split) if a.split is not None else None
     return _wrap(result, split, a)
@@ -313,6 +333,10 @@ def triu(m: DNDarray, k: int = 0) -> DNDarray:
 def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
     """Conjugated dot product of flattened arrays (reference: basics.py)."""
     sanitize_in(x1), sanitize_in(x2)
+    if x1._is_planar or x2._is_planar:
+        from .. import complex_planar as _cp
+
+        return _cp.vdot(x1, x2)
     promoted = types.promote_types(x1.dtype, x2.dtype).jax_type()
     result = jnp.vdot(x1.larray.astype(promoted), x2.larray.astype(promoted))
     return _wrap(result, None, x1)
@@ -323,6 +347,10 @@ def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdims: boo
     sanitize_in(x1), sanitize_in(x2)
     if axis is None:
         axis = -1
+    if x1._is_planar or x2._is_planar:
+        from .. import complex_planar as _cp
+
+        return _cp.vecdot(x1, x2, axis=axis, keepdims=keepdims)
     promoted = types.promote_types(x1.dtype, x2.dtype).jax_type()
     prod = jnp.conj(x1.larray.astype(promoted)) * x2.larray.astype(promoted)
     result = jnp.sum(prod, axis=axis, keepdims=keepdims)

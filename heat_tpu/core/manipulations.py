@@ -172,6 +172,10 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
         sanitize_in(a)
     ref = arrays[0]
     axis = sanitize_axis(ref.shape, axis)
+    if any(a._is_planar for a in arrays):
+        from . import complex_planar as _cp
+
+        return _cp.concat(arrays, axis)
     out_dtype = arrays[0].dtype
     for a in arrays[1:]:
         out_dtype = types.promote_types(out_dtype, a.dtype)
@@ -228,6 +232,10 @@ def expand_dims(a: DNDarray, axis: int) -> DNDarray:
     """Insert a new axis (reference: manipulations.py expand_dims)."""
     sanitize_in(a)
     axis = sanitize_axis(tuple(a.shape) + (1,), axis)
+    if a._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.expand_dims(a, axis)
     result = jnp.expand_dims(a.larray, axis)
     split = a.split
     if split is not None and axis <= split:
@@ -239,6 +247,10 @@ def flatten(a: DNDarray) -> DNDarray:
     """Collapse into one dimension (reference: manipulations.py flatten —
     resplits to 0)."""
     sanitize_in(a)
+    if a._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.flatten(a)
     result = jnp.ravel(a.larray)
     split = 0 if a.split is not None else None
     return _wrap(result, split, a, dtype=a.dtype)
@@ -248,6 +260,10 @@ def flip(a: DNDarray, axis: Optional[Union[int, Tuple[int, ...]]] = None) -> DND
     """Reverse element order along axis (reference: manipulations.py flip)."""
     sanitize_in(a)
     axis = sanitize_axis(a.shape, axis)
+    if a._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.flip(a, axis)
     result = jnp.flip(a.larray, axis=axis)
     return _wrap(result, a.split, a, dtype=a.dtype)
 
@@ -409,6 +425,10 @@ def reshape(a: DNDarray, *shape, **kwargs) -> DNDarray:
             # fewer output dims than the old split axis: clamp to the last
             new_split = len(shape) - 1
     new_split = sanitize_axis(shape, new_split)
+    if a._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.reshape(a, tuple(shape), new_split)
     if new_split is not None and len(shape) > 0 and a.ndim > 0 and a.size != 0:
         # zero-SIZE arrays take the eager path: XLA stores them replicated,
         # which a pinned out_sharding cannot express
@@ -429,6 +449,10 @@ def roll(x: DNDarray, shift, axis=None) -> DNDarray:
     """Roll elements along axis (reference: manipulations.py:2156 — ring
     Isend/Irecv; here jnp.roll, the ppermute emitted by XLA)."""
     sanitize_in(x)
+    if x._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.roll(x, shift, axis)
     result = jnp.roll(x.larray, shift, axis=axis)
     return _wrap(result, x.split, x, dtype=x.dtype)
 
@@ -440,6 +464,10 @@ def rot90(m: DNDarray, k: int = 1, axes: Sequence[int] = (0, 1)) -> DNDarray:
     if len(axes) != 2 or axes[0] == axes[1]:
         raise ValueError("len(axes) must be 2 with distinct elements")
     ax = sanitize_axis(m.shape, axes)
+    if m._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.rot90(m, k, ax)
     result = jnp.rot90(m.larray, k=k, axes=axes)
     split = m.split
     if split is not None and k % 2 == 1 and split in ax:
@@ -588,6 +616,10 @@ def squeeze(x: DNDarray, axis: Optional[Union[int, Tuple[int, ...]]] = None) -> 
                 raise ValueError(
                     f"Dimension along axis {ax} is not 1 for shape {x.shape}"
                 )
+    if x._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.squeeze(x, axes)
     result = jnp.squeeze(x.larray, axis=axes)
     split = x.split
     if split is not None:
@@ -611,6 +643,12 @@ def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
             raise ValueError(
                 f"all input arrays must have the same shape, got {a.shape} != {ref.shape}"
             )
+    if any(a._is_planar for a in arrays):
+        from . import complex_planar as _cp
+
+        if out is not None:
+            raise _cp.policy_error("stack with out= on complex arrays")
+        return _cp.stack_new_axis(arrays, axis)
     out_dtype = ref.dtype
     for a in arrays[1:]:
         out_dtype = types.promote_types(out_dtype, a.dtype)

@@ -19,6 +19,10 @@ def copy(a: DNDarray) -> DNDarray:
     from .sanitation import sanitize_in
 
     sanitize_in(a)
+    if a._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.copy(a)
     return DNDarray(
         jnp.array(a.larray), a.gshape, a.dtype, a.split, a.device, a.comm, balanced=True
     )

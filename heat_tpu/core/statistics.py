@@ -323,6 +323,10 @@ def mean(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
     numpy-style superset of the reference signature, matching this
     module's var/std/min/max/median."""
     sanitize_in(x)
+    if x._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.reduce(jnp.mean, x, axis=axis, keepdims=bool(keepdims))
     axis = sanitize_axis(x.shape, axis)
     arr = x.larray
     if types.heat_type_is_exact(x.dtype):

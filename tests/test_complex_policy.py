@@ -1,16 +1,17 @@
-"""Complex platform policy (VERDICT r4 #3): complex dtypes are allowed on
-cpu/gpu and refused AT CREATION TIME on TPU plugin backends — whose XLA
-backend has no complex implementation and (measured on the bench chip)
-is left permanently failing by a single enqueued complex op, so there is
-nothing to probe or degrade to. The refusal must be an actionable
+"""Complex platform policy, REFUSE mode (VERDICT r4 #3): complex dtypes
+are native on cpu/gpu; TPU plugin backends — whose XLA backend has no
+complex implementation and (measured on the bench chip) is left
+permanently failing by a single enqueued complex op — default to the
+PLANAR representation (tests/test_complex_planar.py). ``ht.use_complex(
+False)`` opts into the round-4 fail-fast behavior instead: an actionable
 TypeError naming the policy, raised before anything reaches the device,
 from every creation path. Reference parity note: complex_math.py:1-110
-runs on every torch device class; this is the documented deviation
-(docs/MIGRATING.md, 'Complex platform policy').
+runs on every torch device class; the planar surface (and this opt-in
+refusal) is the documented deviation (docs/MIGRATING.md, 'Complex
+platform policy').
 
-The refusal mode is platform-independent logic: forced here on the CPU
-suite via ``ht.use_complex(False)`` — the exact state a TPU world boots
-into (devices.supports_complex resolves backend 'tpu' → False)."""
+The refusal mode is platform-independent logic, forced here on the CPU
+suite via ``ht.use_complex(False)``."""
 
 import numpy as np
 import pytest
