@@ -281,9 +281,14 @@ def _combine_prog(comm, ndim, split):
 
 
 def to_planar(x: DNDarray) -> DNDarray:
-    """Real/integer DNDarray -> planar complex (zero imaginary plane)."""
+    """Real/integer DNDarray -> planar complex (zero imaginary plane).
+    A NATIVE complex DNDarray (created on a supporting backend before the
+    mode was switched to planar) stages through the host so both planes
+    survive — astype(f32) on it would silently drop the imaginary part."""
     if is_planar(x):
         return x
+    if types.heat_type_is_complexfloating(x.dtype):
+        return from_host_complex(x.numpy().astype(np.complex64), x.split, x.device, x.comm)
     prog = _to_planar_prog(x.comm, x.ndim, x.split)
     return wrap(prog(x._phys), x.gshape, x.split, x.device, x.comm)
 
@@ -405,7 +410,11 @@ def binary(op, t1, t2, out=None, where=None, fn_kwargs: Optional[dict] = None) -
 
     s1, s2 = _out_split(o1), _out_split(o2)
     if s1 is not None and s2 is not None and s1 != s2:
-        raise policy_error("binary ops on complex operands with mismatched splits")
+        # align the non-dominant operand to o1's split (the same
+        # redistribution __binary_op performs for real operands)
+        tgt = s1 - (out_lnd - o2.ndim)
+        o2 = o2.resplit(tgt if tgt >= 0 else None)
+        s2 = _out_split(o2)
     split = s1 if s1 is not None else s2
     if split is not None and out_shape[split] <= 1:
         split = None

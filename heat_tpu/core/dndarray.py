@@ -790,9 +790,18 @@ class DNDarray:
             # basic keys cover the logical dims; the plane axis rides along
             result = _cp._planar_view(self)[basic]
             gshape = tuple(int(s) for s in result.shape[:-1])
+            # preserve the split when the key slices (not drops) its axis:
+            # re-sharding replicated would all-gather the selection
+            out_split = None
+            if self.__split is not None and isinstance(basic[self.__split], slice):
+                out_split = self.__split - sum(
+                    1 for k in basic[: self.__split] if isinstance(k, int)
+                )
+                if out_split >= len(gshape) or gshape[out_split] <= 1:
+                    out_split = None
             return DNDarray(
-                self.__comm.shard(result, None), gshape, types.complex64,
-                None, self.__device, self.__comm,
+                self.__comm.shard(result, out_split), gshape, types.complex64,
+                out_split, self.__device, self.__comm,
             )
         if isinstance(key, LocalIndex):
             return self.__array[key.obj]

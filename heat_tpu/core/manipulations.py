@@ -545,6 +545,10 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     the sharded array is already collective-free.
     """
     sanitize_in(a)
+    if a._is_planar:
+        from . import complex_planar as _cp
+
+        raise _cp.policy_error("ht.sort on a complex array (complex has no total order)")
     axis = sanitize_axis(a.shape, axis)
     if axis is None:
         axis = a.ndim - 1

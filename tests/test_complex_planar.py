@@ -193,6 +193,25 @@ class TestArithmetic:
         row = ht.array(Z2)  # (6,)
         np.testing.assert_allclose((x * row).numpy(), a2 * Z2, rtol=1e-5)
 
+    def test_mismatched_splits_realign(self):
+        # code-review r5: splits landing on different output axes must
+        # redistribute (as the real __binary_op does), not refuse
+        a2 = np.stack([Z1, Z2])
+        r = ht.array(a2, split=0) * ht.array(Z2, split=0)
+        np.testing.assert_allclose(r.numpy(), a2 * Z2, rtol=1e-5)
+        o = ht.outer(ht.array(Z1, split=0), ht.array(Z2, split=0))
+        np.testing.assert_allclose(o.numpy(), np.outer(Z1, Z2), rtol=1e-5)
+
+    def test_native_complex_operand_keeps_imag(self):
+        # code-review r5: a native complex array created before the mode
+        # switch must not lose its imaginary plane in planar dispatch
+        devices._complex_choice = True
+        xn = ht.array(Z1)
+        assert not xn._is_planar
+        ht.use_complex("planar")
+        prod = xn * ht.array(Z2)
+        np.testing.assert_allclose(prod.numpy(), Z1 * Z2, rtol=1e-5)
+
 
 # --------------------------------------------------------------------- #
 # transcendental / predicates                                           #
@@ -285,6 +304,18 @@ class TestStructural:
     def test_getitem_on_split(self):
         x = ht.array(Z1, split=0)
         np.testing.assert_allclose(x[1:4].numpy(), Z1[1:4])
+
+    def test_getitem_preserves_split(self):
+        # code-review r5: slicing a split planar array must stay sharded
+        # (replicating would all-gather), int keys drop the split
+        big = np.tile(Z1, 4)
+        xs = ht.array(big, split=0)
+        sl = xs[2:20]
+        assert sl.split == 0
+        np.testing.assert_allclose(sl.numpy(), big[2:20])
+        m = ht.array(np.outer(big, Z2), split=0)
+        assert m[1:, ::2].split == 0
+        assert m[0].split is None
 
     @pytest.mark.parametrize("split", [None, 0])
     def test_plane_passenger_ops(self, split):
