@@ -36,6 +36,8 @@ code paths fail loudly):
   ``sin``/``cos``/``tan``, ``sinh``/``cosh``/``tanh``
 - predicates: ``isnan``/``isinf``/``isfinite`` (element is nan/inf when
   either plane is — numpy semantics)
+- ``**`` (principal-branch ``exp(b·log a)`` with numpy's zero-base
+  conventions), ``var``/``std`` (real-valued complex variance)
 - reductions: ``sum``/``nansum``/``mean``, ``cumsum``
 - structural: basic-key ``__getitem__``, ``reshape``/``ravel``/
   ``flatten``, ``transpose``/``swapaxes``, ``squeeze``/``expand_dims``,
@@ -162,6 +164,28 @@ def _ccosh(p):
     return _pk(jnp.cosh(_re(p)) * jnp.cos(_im(p)), jnp.sinh(_re(p)) * jnp.sin(_im(p)))
 
 
+def _cpow(a, b):
+    # principal-branch complex power via exp(b·log a), with numpy's
+    # conventions at the edges it routes here: x**0 = 1 for EVERY base
+    # (including nan/inf), 0**0 = 1, 0**(positive real) = 0, nan+nanj
+    # for other zero-base exponents. Integral scalar exponents never
+    # reach this path (binary() routes them through exact repeated
+    # multiplication); non-finite bases with non-integral exponents
+    # follow the exp/log composition rather than npy_cpow's full
+    # special-case table — the documented deviation.
+    r = _cexp(_cmul(b, _clog(a)))
+    azero = ((_re(a) == 0) & (_im(a) == 0))[..., None]
+    bzero = ((_re(b) == 0) & (_im(b) == 0))[..., None]
+    bposreal = ((_im(b) == 0) & (_re(b) > 0))[..., None]
+    one_p = _pk(jnp.ones_like(r[..., 0]), jnp.zeros_like(r[..., 0]))
+    r = jnp.where(
+        azero,
+        jnp.where(bposreal, jnp.zeros_like(r), jnp.full_like(r, jnp.nan)),
+        r,
+    )
+    return jnp.where(bzero, one_p, r)
+
+
 def _cisclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
     # numpy semantics on the complex modulus: |a-b| <= atol + rtol*|b|,
     # exact equality covering infinities, optional nan==nan
@@ -186,6 +210,7 @@ _BINARY_FNS = {
     "eq": lambda a, b: (_re(a) == _re(b)) & (_im(a) == _im(b)),
     "ne": lambda a, b: (_re(a) != _re(b)) | (_im(a) != _im(b)),
     "isclose": _cisclose,
+    "pow": _cpow,
 }
 
 _BINARY = {
@@ -197,6 +222,7 @@ _BINARY = {
     jnp.equal: ("eq", "real"),
     jnp.not_equal: ("ne", "real"),
     jnp.isclose: ("isclose", "real"),
+    jnp.power: ("pow", "planar"),
 }
 
 _UNARY_FNS = {
@@ -324,7 +350,17 @@ def host_complex(x: DNDarray) -> np.ndarray:
     else:
         host = np.asarray(jax.device_get(arr))
     host = host[tuple(slice(0, s) for s in x.gshape)]  # plane axis kept
-    return (host[..., 0] + 1j * host[..., 1]).astype(np.complex64)
+    return assemble_host(host)
+
+
+def assemble_host(planes: np.ndarray) -> np.ndarray:
+    """Plane pairs -> complex64 on host. Componentwise assignment, NOT
+    ``re + 1j*im``: the arithmetic form corrupts non-finite pairs
+    ((inf, nan) -> nan+nanj via numpy's complex multiply/add rules)."""
+    out = np.empty(planes.shape[:-1], np.complex64)
+    out.real = planes[..., 0]
+    out.imag = planes[..., 1]
+    return out
 
 
 # --------------------------------------------------------------------- #
@@ -379,8 +415,55 @@ def _as_planar_operand(t, ref: DNDarray):
     return to_planar(factories.array(np.asarray(t), device=ref.device, comm=ref.comm))
 
 
+@functools.lru_cache(maxsize=256)
+def _int_pow_prog(comm, lnd, split, n, pext, exponent):
+    """Exact integer power by repeated complex multiplication (binary
+    exponentiation, unrolled at trace time) — numpy computes integral
+    powers this way, and exp(b·log a) would lose f32 accuracy and the
+    non-finite special values (code-review r5)."""
+
+    def run(p):
+        one = _pk(jnp.ones_like(_re(p)), jnp.zeros_like(_re(p)))
+        # seed the accumulator with the first odd-bit factor, not 1:
+        # _cmul(one, (inf, 0)) would taint the imag plane with 0*inf=nan
+        acc, base, k = None, p, abs(exponent)
+        while k:
+            if k & 1:
+                acc = base if acc is None else _cmul(acc, base)
+            k >>= 1
+            if k:
+                base = _cmul(base, base)
+        if acc is None:  # exponent 0: every base -> 1 (numpy rule)
+            acc = one
+        if exponent < 0:
+            acc = _cdiv(one, acc)
+        if split is not None and pext != n:
+            # e=0 writes ones (and negative e infs) into the pad tail
+            acc = _padding.mask_tail(acc, split, n)
+        return acc
+
+    return comm.jit_sharded(run, lnd + 1, split)
+
+
 def binary(op, t1, t2, out=None, where=None, fn_kwargs: Optional[dict] = None) -> DNDarray:
     """Planar replacement for ``_operations.__binary_op``."""
+    if (
+        op is jnp.power
+        and isinstance(t1, DNDarray)
+        and isinstance(t2, (int, float, np.integer, np.floating))
+        and not isinstance(t2, bool)
+        and float(t2).is_integer()
+        and abs(int(t2)) <= 64
+        and out is None
+        and where is None
+    ):
+        x = to_planar(t1)
+        n, pext = (None, None)
+        if x.split is not None:
+            n = x.gshape[x.split]
+            pext = x._planar_phys.shape[x.split]
+        prog = _int_pow_prog(x.comm, x.ndim, x.split, n, pext, int(t2))
+        return wrap(prog(x._planar_phys), x.gshape, x.split, x.device, x.comm)
     entry = _BINARY.get(op)
     opname = getattr(op, "__name__", str(op))
     if entry is None:
@@ -577,6 +660,19 @@ def cum(op, x: DNDarray, axis: int, out=None, dtype=None) -> DNDarray:
         pext = x._planar_phys.shape[x.split]
     prog = _cumsum_prog(x.comm, x.ndim, x.split, n, pext, axis)
     return wrap(prog(x._planar_phys), x.gshape, x.split, x.device, x.comm)
+
+
+def var(x: DNDarray, axis=None, ddof: int = 0, keepdims: bool = False) -> DNDarray:
+    """Complex variance, numpy semantics: ``mean(|x - mean(x)|²)`` — a
+    REAL result, so ``std`` flows through the real sqrt automatically and
+    the squared-modulus accumulation runs on the ordinary real path."""
+    axis = sanitize_axis(x.shape, axis)
+    mu = reduce(jnp.mean, x, axis=axis, keepdims=True)
+    absd = local(jnp.abs, binary(jnp.subtract, x, mu))  # real f32 DNDarray
+    axes = tuple(range(x.ndim)) if axis is None else ((axis,) if isinstance(axis, int) else tuple(axis))
+    count = int(np.prod([x.gshape[a] for a in axes])) if axes else 1
+    s = (absd * absd).sum(axis=axis, keepdims=keepdims)
+    return s / float(count - ddof)
 
 
 # --------------------------------------------------------------------- #

@@ -93,12 +93,14 @@ def __str__(dndarray) -> str:
     summarized = False
     if dndarray._is_planar:
         # planar complex: format the host complex64 assembly through the
-        # shared block below (dtype.kind 'c' passes the biufc check); the
-        # edge-slice fast path reads .larray, so summarize on host instead
-        data = dndarray.numpy()
-        if data.size > opts["threshold"] and data.ndim > 0:
-            data = _edge_block(data, opts["edgeitems"])
+        # shared block below (dtype.kind 'c' passes the biufc check).
+        # Large arrays edge-slice the PLANE VIEW on device first — a full
+        # numpy() here would allgather the whole array to render ~6 items
+        if dndarray.size > opts["threshold"] and dndarray.ndim > 0:
+            data = _planar_summarized(dndarray, opts["edgeitems"])
             summarized = True
+        else:
+            data = dndarray.numpy()
     elif LOCAL_PRINT:
         arr = dndarray.larray
         data = np.asarray(arr.addressable_shards[0].data) if arr.addressable_shards else np.asarray(arr)
@@ -125,16 +127,20 @@ def __str__(dndarray) -> str:
     return f"DNDarray({body}, dtype=ht.{dtype_name}, device={dndarray.device}, split={dndarray.split})"
 
 
-def _edge_block(data: np.ndarray, edgeitems: int) -> np.ndarray:
-    """Host-side edge slicing for arrays already on host (planar complex
-    assemblies) — same selection as ``_summarized_numpy``."""
-    for d, s in enumerate(data.shape):
+def _planar_summarized(dndarray, edgeitems: int) -> np.ndarray:
+    """Edge slices of a planar complex array, selected from the plane
+    view ON DEVICE (same selection as ``_summarized_numpy``; only the
+    displayed items reach the host) and assembled to complex64."""
+    from . import complex_planar as _cp
+
+    sub = _cp._planar_view(dndarray)  # (gshape..., 2)
+    for d, s in enumerate(dndarray.shape):
         if s > 2 * edgeitems + 1:
             ix = np.r_[0 : edgeitems + 1, s - edgeitems : s]
         else:
             ix = np.arange(s)
-        data = np.take(data, ix, axis=d)
-    return data
+        sub = jnp.take(sub, jnp.asarray(ix), axis=d)
+    return _cp.assemble_host(np.asarray(sub))
 
 
 def _summarized_numpy(dndarray, edgeitems: int) -> np.ndarray:

@@ -501,10 +501,14 @@ def var(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
     if bessel is not None:
         ddof = 1 if bessel else 0
     axis = sanitize_axis(x.shape, axis)
+    keepdims = kwargs.get("keepdims", False)
+    if x._is_planar:
+        from . import complex_planar as _cp
+
+        return _cp.var(x, axis=axis, ddof=ddof, keepdims=bool(keepdims))
     arr = x.larray
     if types.heat_type_is_exact(x.dtype):
         arr = arr.astype(jnp.float32)
-    keepdims = kwargs.get("keepdims", False)
     result = jnp.var(arr, axis=axis, ddof=ddof, keepdims=keepdims)
     return _wrap_reduce(jnp.asarray(result), x, axis, keepdims)
 

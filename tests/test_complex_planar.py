@@ -282,6 +282,54 @@ class TestReductions:
         z = np.array([1 + 1j, np.nan + 2j, 3 - 1j], np.complex64)
         np.testing.assert_allclose(ht.nansum(_mk(z)).numpy(), np.nansum(z), rtol=1e-5)
 
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_var_std(self, split):
+        a2 = np.stack([Z1, Z2, Z1 * (1 - 1j)])
+        x = ht.array(a2, split=split)
+        np.testing.assert_allclose(ht.var(x).numpy(), np.var(a2), rtol=1e-4)
+        np.testing.assert_allclose(ht.var(x, axis=0).numpy(), np.var(a2, axis=0), rtol=1e-4)
+        np.testing.assert_allclose(
+            ht.var(x, axis=1, ddof=1, keepdims=True).numpy(),
+            np.var(a2, axis=1, ddof=1, keepdims=True),
+            rtol=1e-4,
+        )
+        s = ht.std(x)
+        assert s.dtype == ht.float32  # complex variance is REAL
+        np.testing.assert_allclose(s.numpy(), np.std(a2), rtol=1e-4)
+
+    def test_pow(self):
+        z = Z1[Z1 != 0]
+        x = ht.array(z)
+        np.testing.assert_allclose((x**2).numpy(), z**2, rtol=1e-4)
+        np.testing.assert_allclose((x**-1).numpy(), z ** (-1.0), rtol=1e-4)
+        np.testing.assert_allclose((x**0.5).numpy(), z**0.5, rtol=1e-4)
+        np.testing.assert_allclose((x ** (1 + 1j)).numpy(), z ** (1 + 1j), rtol=1e-3)
+        zb = np.array([0 + 0j, 3 + 1j], np.complex64)
+        b = ht.array(zb)
+        with np.errstate(all="ignore"):
+            assert (b**0).numpy()[0] == 1 and (b**2).numpy()[0] == 0
+            assert np.isnan((b ** (1j)).numpy()[0])
+
+    def test_pow_integer_exact_and_nonfinite(self):
+        # code-review r5: integral exponents run exact repeated complex
+        # multiplication (not exp/log), and x**0 == 1 for EVERY base
+        with np.errstate(all="ignore"):
+            z = np.array([np.nan + 0j, np.inf + 0j, -1 - 1j, 2 + 3j], np.complex64)
+            x = ht.array(z)
+            np.testing.assert_array_equal((x**0).numpy(), np.ones(4, np.complex64))
+            sq = (x**2).numpy()
+            assert sq[2] == (-1 - 1j) ** 2  # exact, no exp/log roundoff
+            assert sq[1] == np.complex64(np.inf) ** 2 or (
+                np.isinf(sq[1].real) and np.isnan(sq[1].imag)
+            )
+            np.testing.assert_allclose((x**-3).numpy()[2:], z[2:] ** (-3.0), rtol=1e-5)
+
+    def test_numpy_roundtrip_nonfinite(self):
+        # host assembly must be componentwise (re + 1j*im corrupts
+        # (inf, nan) pairs — code-review r5)
+        z = np.array([np.inf + 1j, 1 - 1j * np.inf, np.nan + 2j], np.complex64)
+        np.testing.assert_array_equal(ht.array(z).numpy(), z)
+
     def test_cumsum(self):
         x = _mk(Z1)
         np.testing.assert_allclose(ht.cumsum(x, 0).numpy(), np.cumsum(Z1), rtol=1e-5)
@@ -450,8 +498,6 @@ class TestRefusals:
         x = _mk(Z1)
         self._check(lambda: ht.sort(x))
         self._check(lambda: ht.linalg.inv(ht.array(np.outer(Z1, Z2)[:4, :4] + np.eye(4))))
-        self._check(lambda: ht.var(x))
-        self._check(lambda: x**2)
         self._check(lambda: ht.maximum(x, x))
         self._check(lambda: ht.prod(x))
         self._check(lambda: ht.floor(x))
