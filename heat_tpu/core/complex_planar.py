@@ -38,7 +38,8 @@ code paths fail loudly):
   either plane is — numpy semantics)
 - ``**`` (principal-branch ``exp(b·log a)`` with numpy's zero-base
   conventions), ``var``/``std`` (real-valued complex variance)
-- reductions: ``sum``/``nansum``/``mean``, ``cumsum``
+- reductions: ``sum``/``nansum``/``mean``, ``prod`` (log-depth
+  pairwise complex-multiply tree), ``cumsum``
 - structural: basic-key ``__getitem__``, ``reshape``/``ravel``/
   ``flatten``, ``transpose``/``swapaxes``, ``squeeze``/``expand_dims``,
   ``flip``/``fliplr``/``flipud``/``rot90``, ``roll``, ``concatenate``/
@@ -176,7 +177,8 @@ def _cpow(a, b):
     r = _cexp(_cmul(b, _clog(a)))
     azero = ((_re(a) == 0) & (_im(a) == 0))[..., None]
     bzero = ((_re(b) == 0) & (_im(b) == 0))[..., None]
-    bposreal = ((_im(b) == 0) & (_re(b) > 0))[..., None]
+    # npy_cpow zeroes 0**b for ANY b with positive real part (imag free)
+    bposreal = (_re(b) > 0)[..., None]
     one_p = _pk(jnp.ones_like(r[..., 0]), jnp.zeros_like(r[..., 0]))
     r = jnp.where(
         azero,
@@ -283,7 +285,31 @@ _UNARY = {
     jnp.rint: ("rint", "planar"),
 }
 
-_REDUCE = {jnp.sum: "sum", jnp.nansum: "nansum", jnp.mean: "mean"}
+_REDUCE = {jnp.sum: "sum", jnp.nansum: "nansum", jnp.mean: "mean", jnp.prod: "prod"}
+
+
+def _cprod_axis(p, axis: int):
+    """Complex product along one logical axis as a log-depth pairwise
+    ``_cmul`` tree (the complex analog of a pairwise reduce; exact
+    complex multiplication, vectorized across the other axes — no
+    sequential scan)."""
+    n = p.shape[axis]
+    if n == 0:
+        # empty product = multiplicative identity 1+0j (numpy semantics)
+        shape = list(p.shape)
+        shape[axis] = 1
+        return jnp.zeros(tuple(shape), p.dtype).at[..., 0].set(1.0)
+    while n > 1:
+        half = n // 2
+        lo = jax.lax.slice_in_dim(p, 0, half, axis=axis)
+        hi = jax.lax.slice_in_dim(p, half, 2 * half, axis=axis)
+        merged = _cmul(lo, hi)
+        if n % 2:
+            tail = jax.lax.slice_in_dim(p, 2 * half, n, axis=axis)
+            merged = jnp.concatenate([merged, tail], axis=axis)
+        p = merged
+        n = p.shape[axis]
+    return p
 
 
 # --------------------------------------------------------------------- #
@@ -575,12 +601,22 @@ def local(op, x: DNDarray, out=None, kwargs: Optional[dict] = None) -> DNDarray:
 @functools.lru_cache(maxsize=1024)
 def _reduce_prog(name, comm, lnd, split, n, pext, axes, keepdims, out_split, out_n, out_pext, count):
     def run(p):
-        if name == "nansum":
-            p = jnp.where(_cnan(p)[..., None], jnp.zeros_like(p), p)
-        # pad planes are zero -> sum-safe without a neutral refill
-        r = jnp.sum(p, axis=axes, keepdims=keepdims)
-        if name == "mean":
-            r = r / np.float32(count)
+        if name == "prod":
+            if split is not None and split in axes and pext != n:
+                # the zero pad would multiply in: refill with 1+0j
+                iota = jax.lax.broadcasted_iota(jnp.int32, p.shape[:-1], split)
+                one_p = _pk(jnp.ones_like(p[..., 0]), jnp.zeros_like(p[..., 0]))
+                p = jnp.where((iota < n)[..., None], p, one_p)
+            for ax in axes:
+                p = _cprod_axis(p, ax)
+            r = p if keepdims else jnp.squeeze(p, axis=axes)
+        else:
+            if name == "nansum":
+                p = jnp.where(_cnan(p)[..., None], jnp.zeros_like(p), p)
+            # pad planes are zero -> sum-safe without a neutral refill
+            r = jnp.sum(p, axis=axes, keepdims=keepdims)
+            if name == "mean":
+                r = r / np.float32(count)
         if out_split is not None and out_pext != out_n:
             r = _padding.mask_tail(r, out_split, out_n)
         return r

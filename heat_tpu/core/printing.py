@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 __all__ = ["get_printoptions", "global_printing", "local_printing", "print0", "set_printoptions"]
@@ -127,34 +128,38 @@ def __str__(dndarray) -> str:
     return f"DNDarray({body}, dtype=ht.{dtype_name}, device={dndarray.device}, split={dndarray.split})"
 
 
-def _planar_summarized(dndarray, edgeitems: int) -> np.ndarray:
-    """Edge slices of a planar complex array, selected from the plane
-    view ON DEVICE (same selection as ``_summarized_numpy``; only the
-    displayed items reach the host) and assembled to complex64."""
-    from . import complex_planar as _cp
-
-    sub = _cp._planar_view(dndarray)  # (gshape..., 2)
-    for d, s in enumerate(dndarray.shape):
+def _edge_take(arr, shape, edgeitems: int):
+    """Select the displayed edge slices of ``arr`` along each dim of the
+    LOGICAL ``shape`` (trailing extra axes ride along) — the one place
+    the edge-selection rule lives. Host ndarrays stay on host (a complex
+    host array must never round-trip through the device in planar mode)."""
+    on_host = isinstance(arr, np.ndarray)
+    for d, s in enumerate(shape):
         if s > 2 * edgeitems + 1:
             ix = np.r_[0 : edgeitems + 1, s - edgeitems : s]
         else:
             ix = np.arange(s)
-        sub = jnp.take(sub, jnp.asarray(ix), axis=d)
+        arr = np.take(arr, ix, axis=d) if on_host else jnp.take(arr, jnp.asarray(ix), axis=d)
+    return arr
+
+
+def _planar_summarized(dndarray, edgeitems: int) -> np.ndarray:
+    """Edge slices of a planar complex array, selected from the plane
+    view ON DEVICE (same selection as ``_summarized_numpy``; only the
+    displayed items reach the host) and assembled to complex64. In a
+    multi-process world the plane array spans non-addressable devices,
+    which ``np.asarray`` cannot fetch — fall back to the allgathering
+    ``numpy()`` export there."""
+    from . import complex_planar as _cp
+
+    view = _cp._planar_view(dndarray)  # (gshape..., 2)
+    if jax.process_count() > 1 and not view.is_fully_addressable:
+        return _edge_take(dndarray.numpy(), dndarray.shape, edgeitems)
+    sub = _edge_take(view, dndarray.shape, edgeitems)
     return _cp.assemble_host(np.asarray(sub))
 
 
 def _summarized_numpy(dndarray, edgeitems: int) -> np.ndarray:
     """Fetch only the displayed edge slices to host (the analog of the
     reference's threshold-summarized gather, printing.py:208)."""
-    arr = dndarray.larray
-    idx = []
-    for s in dndarray.shape:
-        if s > 2 * edgeitems + 1:
-            idx.append(np.r_[0 : edgeitems + 1, s - edgeitems : s])
-        else:
-            idx.append(np.arange(s))
-    sub = arr
-    for d, ix in enumerate(idx):
-        sub = jnp.take(sub, jnp.asarray(ix), axis=d)
-    out = np.asarray(sub)
-    return out
+    return np.asarray(_edge_take(dndarray.larray, dndarray.shape, edgeitems))

@@ -278,6 +278,30 @@ class TestReductions:
         np.testing.assert_allclose(ht.sum(x).numpy(), z.sum(), rtol=1e-5)
         np.testing.assert_allclose(ht.mean(x).numpy(), z.mean(), rtol=1e-5)
 
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_prod(self, split):
+        # unit-modulus-ish values keep the product inside f32 range;
+        # split=1 over 8 devices pads the REDUCED axis (neutral refill)
+        rng = np.random.default_rng(2)
+        z = (
+            np.exp(1j * rng.uniform(0, 2 * np.pi, (3, 10)))
+            * rng.uniform(0.9, 1.1, (3, 10))
+        ).astype(np.complex64)
+        x = ht.array(z, split=split)
+        np.testing.assert_allclose(ht.prod(x).numpy(), z.prod(), rtol=1e-4)
+        np.testing.assert_allclose(ht.prod(x, axis=1).numpy(), z.prod(1), rtol=1e-4)
+        np.testing.assert_allclose(
+            ht.prod(x, axis=0, keepdims=True).numpy(), z.prod(0, keepdims=True), rtol=1e-4
+        )
+
+    def test_prod_empty_is_identity(self):
+        # empty product = 1 (numpy; code-review r5)
+        assert ht.prod(ht.array(np.zeros((0,), np.complex64))).numpy() == 1
+        np.testing.assert_array_equal(
+            ht.prod(ht.array(np.zeros((3, 0), np.complex64)), axis=1).numpy(),
+            np.ones(3, np.complex64),
+        )
+
     def test_nansum(self):
         z = np.array([1 + 1j, np.nan + 2j, 3 - 1j], np.complex64)
         np.testing.assert_allclose(ht.nansum(_mk(z)).numpy(), np.nansum(z), rtol=1e-5)
@@ -323,6 +347,10 @@ class TestReductions:
                 np.isinf(sq[1].real) and np.isnan(sq[1].imag)
             )
             np.testing.assert_allclose((x**-3).numpy()[2:], z[2:] ** (-3.0), rtol=1e-5)
+            # 0 ** b zeroes for ANY b with positive real part (npy_cpow;
+            # code-review r5 — the imag part of b is free)
+            zero = ht.array(np.array([0j], np.complex64))
+            assert (zero ** (2 + 1j)).numpy()[0] == 0
 
     def test_numpy_roundtrip_nonfinite(self):
         # host assembly must be componentwise (re + 1j*im corrupts
@@ -499,7 +527,6 @@ class TestRefusals:
         self._check(lambda: ht.sort(x))
         self._check(lambda: ht.linalg.inv(ht.array(np.outer(Z1, Z2)[:4, :4] + np.eye(4))))
         self._check(lambda: ht.maximum(x, x))
-        self._check(lambda: ht.prod(x))
         self._check(lambda: ht.floor(x))
 
     def test_ordering_comparisons_raise(self):
