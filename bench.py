@@ -189,6 +189,22 @@ def _measure_bounded(thunk, floor_seconds, retries=2):
     return t
 
 
+def _measure_bounded_group(thunk, floors, retries=2):
+    """The floor/retry machinery of ``_measure_bounded`` for a GROUP
+    measurement (``thunk() -> {name: seconds}``, e.g. a
+    ``_chained_slope_group``): while any member sits under its physical
+    floor in ``floors``, re-measure the whole group (members must stay
+    interleaved to see the same tunnel weather) and keep each member's
+    slowest estimate — the safe, under-reporting direction."""
+    out = thunk()
+    for _ in range(retries):
+        if all(out[k] >= f for k, f in floors.items()):
+            break
+        nxt = thunk()
+        out = {k: max(v, nxt[k]) for k, v in out.items()}
+    return out
+
+
 def _progress(name, seconds):
     print(f"[bench] {name}: {seconds*1e3:.3f} ms", file=sys.stderr, flush=True)
 
@@ -733,15 +749,23 @@ def measure_heat_tpu() -> dict:
     method["sort"] = "chained-slope"
     del srt
 
-    # ring attention: output feeds back as the next query
+    # ring attention: output feeds back as the next query. Same
+    # floor/retry machinery as the matmul rows (the r5 attention-MFU
+    # regression went unflagged): a slope under the causal-FLOPs bf16
+    # roofline is tunnel weather, re-measure and keep the slowest.
     qkv = [ht.random.randn(RA_B, RA_H, RA_S, RA_D, split=2) for _ in range(3)]
     qkv_bf = [t.astype(ht.bfloat16) for t in qkv]
-    ra = _chained_slope_group(
-        {
-            "f32": (qkv[0], lambda y: ht.nn.ring_attention(y, qkv[1], qkv[2], causal=True)),
-            "bf16": (qkv_bf[0], lambda y: ht.nn.ring_attention(y, qkv_bf[1], qkv_bf[2], causal=True)),
-        },
-        sync, k1=8, k2=40, reps=4,
+    ra_cb_floor = RA_B * RA_H * 2 * 2 * RA_S * RA_S * RA_D * 0.5 / V5E_BF16_FLOPS
+    ra = _measure_bounded_group(
+        lambda: _chained_slope_group(
+            {
+                "f32": (qkv[0], lambda y: ht.nn.ring_attention(y, qkv[1], qkv[2], causal=True)),
+                "bf16": (qkv_bf[0], lambda y: ht.nn.ring_attention(y, qkv_bf[1], qkv_bf[2], causal=True)),
+            },
+            sync, k1=8, k2=40, reps=4,
+        ),
+        # f32 cannot beat the bf16 MXU peak either — one bound serves both
+        {"f32": ra_cb_floor, "bf16": ra_cb_floor},
     )
     out["ring_attention"] = ra["f32"]
     _progress("ring_attention", out["ring_attention"])
@@ -1152,6 +1176,18 @@ def main() -> None:
     # accumulate work): if a run says otherwise, the f32 sample is weather
     if detail["matmul_f32_8k"].get("mfu", 0) > detail["matmul_bf16_8k"].get("mfu", 1):
         detail["matmul_f32_8k"]["measurement_suspect"] = True
+    # same cross-check for the attention rows (the r5 unflagged-regression
+    # fix): f32 ring attention beating bf16 is the f32 sample's weather
+    if detail["ring_attention"].get("mfu", 0) > detail["ring_attention_bf16"].get("mfu", 1):
+        detail["ring_attention"]["measurement_suspect"] = True
+    # the kernel-ring program IS splash + wrapper work: measuring it >10%
+    # FASTER than the bare splash row means one of the two samples is
+    # weather — flag both, the ratio carries the done-criterion claim
+    if "ring_kernel_p1_16k" in detail:
+        ratio = detail["ring_kernel_p1_16k"].get("vs_splash_row")
+        if ratio is not None and ratio < 0.9:
+            detail["ring_kernel_p1_16k"]["measurement_suspect"] = True
+            detail["ring_attention_16k_bf16"]["measurement_suspect"] = True
 
     result = {
         "metric": (
@@ -1176,6 +1212,30 @@ def main() -> None:
     detail_file = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
     with open(detail_file, "w") as f:
         json.dump(result, f, indent=2)
+
+    # regression gate: diff this run against the latest driver round
+    # artifact (>10% unflagged moves -> BENCH_COMPARE.json + one stderr
+    # line). Guarded: the gate must never take the bench down with it,
+    # and stdout stays the single compact line below.
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_compare
+
+        gate = bench_compare.run(current_path=detail_file)
+        if gate["verdict"] == "skipped":
+            print(
+                f"[bench] regression gate skipped: {gate.get('reason')}",
+                file=sys.stderr, flush=True,
+            )
+        else:
+            print(
+                f"[bench] regression gate: {gate['verdict']} "
+                f"({len([r for r in gate.get('regressions', []) if 'waived' not in r])} unflagged, "
+                f"details in BENCH_COMPARE.json)",
+                file=sys.stderr, flush=True,
+            )
+    except Exception as e:  # pragma: no cover - diagnostics only
+        print(f"[bench] regression gate skipped: {e}", file=sys.stderr, flush=True)
 
     def pick(row, *fields):
         return {f: detail[row][f] for f in fields if f in detail[row]}

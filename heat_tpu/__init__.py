@@ -40,6 +40,7 @@ from . import cluster
 from . import graph
 from . import naive_bayes
 from . import nn
+from . import observability
 from . import optim
 from . import preprocessing
 from . import regression
@@ -47,6 +48,7 @@ from . import sparse
 from . import spatial
 from . import utils
 from . import datasets
+from .observability import telemetry
 from .version import __version__
 
 

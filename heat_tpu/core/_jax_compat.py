@@ -1,0 +1,50 @@
+"""jax version compatibility.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level namespace, renaming ``check_rep`` to ``check_vma`` on the way,
+and ``lax.pcast`` arrived with the varying-mesh-axes (VMA) type system.
+This repo targets the new spellings; the wrappers below keep the library
+importable and correct on runtimes that still ship the experimental
+forms (observed: jax 0.4.x containers):
+
+- ``shard_map``: kwarg-mapped passthrough (all internal call sites use
+  keyword form ``mesh=/in_specs=/out_specs=[/check_vma=]`` only);
+- ``pcast``: identity where VMA tracking does not exist — pre-VMA jax
+  has no replicated/varying distinction to cast between, and every
+  internal use runs under ``check_vma=False``/``check_rep=False``.
+"""
+
+from __future__ import annotations
+
+__all__ = ["pcast", "shard_map"]
+
+try:  # jax with top-level shard_map (check_vma spelling)
+    from jax import shard_map as _new_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return _new_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+except ImportError:  # jax 0.4.x: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        # always False: without pcast the ring programs' device-varying
+        # scan carries cannot be annotated, so pre-VMA replication
+        # tracking mis-infers them (results are unaffected; the checker
+        # is advisory)
+        kw["check_rep"] = False
+        return _exp_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+
+try:  # jax with the VMA type system
+    from jax.lax import pcast
+except ImportError:  # pre-VMA jax: nothing to cast between
+
+    def pcast(x, axis_name, *, to=None):
+        return x

@@ -36,6 +36,7 @@ from . import types
 from . import _padding
 from .dndarray import DNDarray
 from .stride_tricks import broadcast_shape, sanitize_axis
+from ..observability.instrument import observed_program_cache
 
 __all__ = []
 
@@ -150,6 +151,7 @@ def _resolve_neutral(tag, dtype):
 # --------------------------------------------------------------------- #
 # cached jitted executors                                               #
 # --------------------------------------------------------------------- #
+@observed_program_cache("op.binary")
 @functools.lru_cache(maxsize=4096)
 def _binary_callable(op, comm, out_ndim, split, n, pext, cast, scalar1, scalar2, kw):
     """One compiled program: cast → align pads → op → restore zero pad.
@@ -173,6 +175,7 @@ def _binary_callable(op, comm, out_ndim, split, n, pext, cast, scalar1, scalar2,
     return comm.jit_sharded(fn, out_ndim, split)
 
 
+@observed_program_cache("op.unary")
 @functools.lru_cache(maxsize=4096)
 def _unary_callable(op, comm, ndim, split, n, pext, cast, static_kw, dyn_names):
     def fn(arr, *dyn):
@@ -188,6 +191,7 @@ def _unary_callable(op, comm, ndim, split, n, pext, cast, static_kw, dyn_names):
     return comm.jit_sharded(fn, ndim, split)
 
 
+@observed_program_cache("op.reduce")
 @functools.lru_cache(maxsize=4096)
 def _reduce_callable(op, comm, split, n, pext, axes, keepdims, neutral, out_ndim, out_split, out_n, out_pext, kw):
     def fn(arr):
@@ -203,6 +207,7 @@ def _reduce_callable(op, comm, split, n, pext, axes, keepdims, neutral, out_ndim
     return comm.jit_sharded(fn, out_ndim, out_split)
 
 
+@observed_program_cache("op.cum")
 @functools.lru_cache(maxsize=1024)
 def _cum_callable(op, comm, ndim, split, n, pext, axis, cast):
     def fn(arr):

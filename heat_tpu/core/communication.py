@@ -36,6 +36,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from typing import List, Optional, Tuple, Union
 
+from ..observability import events as _obs_events
+from ..observability import telemetry as _telemetry
+from ..observability.instrument import nbytes_of as _nbytes_of
+
 __all__ = [
     "Communication",
     "MeshCommunication",
@@ -272,6 +276,19 @@ class MeshCommunication(Communication):
         """
         from . import _padding
 
+        if _telemetry._ENABLED:
+            # metadata only (trace-safe); under a trace this fires once
+            # per compile, which the event records
+            nbytes = _nbytes_of(array.shape, array.dtype)
+            _telemetry.inc("comm.shard.calls")
+            _telemetry.inc("comm.shard.bytes", nbytes)
+            _obs_events.emit(
+                "comm.shard",
+                shape=tuple(int(s) for s in array.shape),
+                split=split,
+                bytes=nbytes,
+                traced=isinstance(array, jax.core.Tracer),
+            )
         if split is not None:
             split = split % max(array.ndim, 1)
             if array.shape[split] == 0:
@@ -288,6 +305,20 @@ class MeshCommunication(Communication):
         reference's split→split Isend/Irecv tiling, dndarray.py:1406)."""
         from . import _padding
 
+        if _telemetry._ENABLED:
+            # the moved volume is the LOGICAL payload (every byte crosses
+            # the mesh on a split change; pad rows are manufactured)
+            moved = _nbytes_of(gshape, phys.dtype)
+            _telemetry.inc("comm.reshard.calls")
+            _telemetry.inc("comm.reshard.bytes", moved)
+            _obs_events.emit(
+                "comm.reshard",
+                gshape=tuple(int(s) for s in gshape),
+                old_split=old_split,
+                new_split=new_split,
+                bytes_moved=moved,
+                traced=isinstance(phys, jax.core.Tracer),
+            )
         logical = _padding.unpad(phys, tuple(gshape), old_split)
         return self.shard(logical, new_split)
 

@@ -34,6 +34,8 @@ from . import types
 from .communication import Communication, MeshCommunication, sanitize_comm
 from .devices import Device
 from .stride_tricks import sanitize_axis
+from ..observability import events as _obs_events
+from ..observability import telemetry as _telemetry
 
 __all__ = ["DNDarray"]
 
@@ -518,7 +520,10 @@ class DNDarray:
 
     def balance_(self) -> None:
         """Balance shards (reference dndarray.py:500). GSPMD layouts are
-        canonical — nothing to do."""
+        canonical — nothing to do (the counter records that a caller
+        ported from the reference still expected a data movement here)."""
+        if _telemetry._ENABLED:
+            _telemetry.inc("dndarray.balance.noop")
         return None
 
     def create_lshape_map(self, force_check: bool = False) -> np.ndarray:
@@ -539,6 +544,12 @@ class DNDarray:
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
+        if _telemetry._ENABLED:
+            _telemetry.inc("dndarray.resplit.calls")
+            _obs_events.emit(
+                "dndarray.resplit", gshape=self.__gshape,
+                old_split=self.__split, new_split=axis, in_place=True,
+            )
         self.__array = self.__comm.reshard_phys(self.__array, self.__gshape, self.__split, axis)
         self.__split = axis
         self._invalidate_caches()
@@ -550,6 +561,12 @@ class DNDarray:
         if axis == self.__split:
             return DNDarray(
                 self.__array, self.__gshape, self.__dtype, self.__split, self.__device, self.__comm
+            )
+        if _telemetry._ENABLED:
+            _telemetry.inc("dndarray.resplit.calls")
+            _obs_events.emit(
+                "dndarray.resplit", gshape=self.__gshape,
+                old_split=self.__split, new_split=axis, in_place=False,
             )
         arr = self.__comm.reshard_phys(self.__array, self.__gshape, self.__split, axis)
         return DNDarray(arr, self.__gshape, self.__dtype, axis, self.__device, self.__comm)
@@ -579,6 +596,12 @@ class DNDarray:
             raise ValueError("target rank is out of bounds")
         from . import _padding
 
+        if _telemetry._ENABLED:
+            _telemetry.inc("dndarray.collect.calls")
+            _obs_events.emit(
+                "dndarray.collect", gshape=self.__gshape,
+                old_split=self.__split, target_rank=target_rank,
+            )
         device = self.__comm.devices[target_rank]
         logical = _padding.unpad(self.__array, self.__gshape, self.__split)
         self.__array = jax.device_put(logical, jax.sharding.SingleDeviceSharding(device))

@@ -42,6 +42,7 @@ Limitations (clear errors, not wrong answers):
 from __future__ import annotations
 
 import functools
+import time
 import warnings
 
 import numpy as np
@@ -51,6 +52,8 @@ import jax
 from typing import Callable, Optional
 
 from .dndarray import DNDarray
+from ..observability import events as _obs_events
+from ..observability import telemetry as _telemetry
 
 __all__ = ["jit"]
 
@@ -207,6 +210,7 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
         key = (treedef, specs)
 
         entry = cache.get(key)
+        is_new_entry = entry is None
         if entry is None:
             out_box = []
 
@@ -279,6 +283,15 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
                 jitted_inner = jax.jit(
                     inner, donate_argnums=donate_positions, **jit_kwargs
                 )
+                if _telemetry._ENABLED:
+                    # donation decision: how many traced buffers actually
+                    # get donated for this signature (statics drop out)
+                    _telemetry.inc("ht.jit.donated_buffers", len(donate_positions))
+                    _obs_events.emit(
+                        "ht.jit.donation", fn=getattr(fn, "__name__", "<fn>"),
+                        requested_args=len(donate_user),
+                        donated_buffers=len(donate_positions),
+                    )
             else:
                 jitted_inner = jax.jit(inner, **jit_kwargs)
             _warn_closure_captures(fn)
@@ -291,7 +304,23 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
             for leaf, (kind, _) in zip(leaves, specs)
             if kind != "static"
         ]
-        phys_out = jitted(*traced_in)
+        if _telemetry._ENABLED:
+            _telemetry.inc("ht.jit.cache.miss" if is_new_entry else "ht.jit.cache.hit")
+            if is_new_entry:
+                # first dispatch of a new signature = trace + XLA compile
+                # (+ one execution); later hits pay only program dispatch
+                t0 = time.perf_counter()
+                phys_out = jitted(*traced_in)
+                dt = time.perf_counter() - t0
+                _telemetry.observe("ht.jit.compile", dt)
+                _obs_events.emit(
+                    "ht.jit.trace", fn=getattr(fn, "__name__", "<fn>"),
+                    leaves=len(leaves), seconds=round(dt, 6),
+                )
+            else:
+                phys_out = jitted(*traced_in)
+        else:
+            phys_out = jitted(*traced_in)
         if not out_box:
             # cache hit on a program jax.jit compiled earlier but whose
             # out-metadata box was lost — cannot happen (box fills on first

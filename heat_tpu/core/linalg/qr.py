@@ -40,6 +40,7 @@ from typing import Optional, Tuple, Union
 
 from .. import types
 from .. import _padding
+from .._jax_compat import shard_map as _shard_map
 from ..communication import MeshCommunication
 from ..dndarray import DNDarray
 from ..sanitation import sanitize_in
@@ -123,7 +124,7 @@ def _tsqr_fn(mesh, axis_name: str, lrows: int, cols: int, jdtype: str, calc_q: b
     else:
         out_specs = PartitionSpec(None, None)
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
         )
     )

@@ -45,6 +45,7 @@ from typing import Optional, Tuple, Union
 
 from .. import types
 from .. import _padding
+from .._jax_compat import shard_map as _shard_map
 from ..communication import MeshCommunication
 from ..dndarray import DNDarray
 from ..sanitation import sanitize_in
@@ -427,7 +428,7 @@ def _local_svd_fn(
         return u_scaled, err_sq[None], norm_sq[None]
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             kernel,
             mesh=mesh,
             in_specs=PartitionSpec(None, axis_name),

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ._jax_compat import pcast, shard_map
 
 from typing import Callable, Optional, Tuple
 
@@ -174,7 +174,7 @@ def _ring_program(
         r = lax.axis_index(axis_name)
         # the scan carry is updated with device-varying blocks each step, so
         # its initial value must be marked varying over the mesh axis
-        out = lax.pcast(jnp.zeros((x_loc.shape[0], p * by), dtype=jdtype), axis_name, to="varying")
+        out = pcast(jnp.zeros((x_loc.shape[0], p * by), dtype=jdtype), axis_name, to="varying")
 
         def step(carry, t):
             y_cur, acc = carry

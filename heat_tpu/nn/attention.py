@@ -28,7 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ..core._jax_compat import pcast, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from typing import Optional
@@ -134,9 +134,9 @@ def _ring_attention_program_cached(
         m0 = jnp.full(q.shape[:-1] + (1,), neg, dtype=q.dtype)
         l0 = jnp.zeros(q.shape[:-1] + (1,), dtype=q.dtype)
         if p > 1:
-            o0 = lax.pcast(o0, axis_name, to="varying")
-            m0 = lax.pcast(m0, axis_name, to="varying")
-            l0 = lax.pcast(l0, axis_name, to="varying")
+            o0 = pcast(o0, axis_name, to="varying")
+            m0 = pcast(m0, axis_name, to="varying")
+            l0 = pcast(l0, axis_name, to="varying")
         k0, v0 = k, v
 
         def step(carry, t):

@@ -1,0 +1,55 @@
+"""First-party runtime observability (reference deviation: the reference
+delegates ALL instrumentation to external tools — perun around its
+benchmark scripts, nothing inside the library).
+
+Three pieces, one import surface:
+
+- :mod:`~heat_tpu.observability.telemetry` — process-wide counters,
+  timers (p50/p95) and the ``record()`` context manager; zero-cost when
+  disabled, ``HEAT_TPU_TELEMETRY=1`` or ``enable()`` to activate. Also
+  exposed as the ``ht.telemetry`` shorthand.
+- :mod:`~heat_tpu.observability.events` — bounded structured event log
+  fed by the hooks in ``core/`` (shard/reshard bytes, program-cache
+  misses, ``ht.jit`` traces).
+- :mod:`~heat_tpu.observability.hlo` — :func:`collective_counts`, the
+  compile-only HLO inspector pinning each op's collective structure
+  (the public form of the MULTICHIP dryrun asserts).
+
+Instrumentation glue for the core layers lives in
+:mod:`~heat_tpu.observability.instrument` (not re-exported).
+"""
+
+from . import events
+from . import hlo
+from . import instrument
+from . import telemetry
+
+from .hlo import COLLECTIVE_OPS, CollectiveReport, collective_counts
+from .telemetry import (
+    disable,
+    enable,
+    enabled,
+    export_jsonl,
+    inc,
+    observe,
+    record,
+    report,
+    reset,
+    snapshot,
+)
+
+__all__ = [
+    "COLLECTIVE_OPS",
+    "CollectiveReport",
+    "collective_counts",
+    "disable",
+    "enable",
+    "enabled",
+    "export_jsonl",
+    "inc",
+    "observe",
+    "record",
+    "report",
+    "reset",
+    "snapshot",
+]

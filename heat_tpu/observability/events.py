@@ -1,0 +1,56 @@
+"""Bounded structured event log.
+
+Counters say *how many*; events say *what, in order*: each hook point
+(``comm.shard``, ``comm.reshard``, ``dndarray.resplit``, program-cache
+misses, ``ht.jit`` traces, user ``record()`` blocks) appends one dict
+with a monotonic sequence number and a timestamp relative to process
+start. The buffer is a fixed-size ring (oldest events drop first), so
+instrumenting a hot loop cannot grow memory without bound.
+
+Callers gate on ``telemetry.enabled()`` BEFORE building the field dict —
+``emit`` itself does not re-check, keeping the enabled path one call
+deep. All field values must be host-side Python data (trace-safety
+contract, see ``telemetry``)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from collections import deque
+from typing import Any, Dict, List
+
+__all__ = ["capacity", "clear", "emit", "snapshot"]
+
+_CAPACITY = 4096
+_T0 = time.perf_counter()
+
+_lock = threading.Lock()
+_events: deque = deque(maxlen=_CAPACITY)
+_seq = 0
+
+
+def emit(kind: str, **fields: Any) -> None:
+    """Append one event. ``kind`` names the hook point; ``fields`` are
+    host-side values (ints/floats/strs/tuples)."""
+    global _seq
+    with _lock:
+        _seq += 1
+        _events.append(
+            {"seq": _seq, "t_s": round(time.perf_counter() - _T0, 6), "event": kind, **fields}
+        )
+
+
+def snapshot() -> List[Dict[str, Any]]:
+    """Copy of the buffered events, oldest first."""
+    with _lock:
+        return [dict(e) for e in _events]
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()
+
+
+def capacity() -> int:
+    return _CAPACITY

@@ -1,0 +1,226 @@
+"""HLO collective inspector.
+
+The multi-chip cost model in docs/PERF.md is only credible because the
+compiled programs are *checked*: the MULTICHIP dryrun and
+``tests/test_parallel_primitives.py`` count ``all-gather`` /
+``collective-permute`` / ``all-to-all`` ops in compiled HLO text. That
+assert machinery lived out-of-tree in scripts; this module promotes it
+into a public, tested API::
+
+    rep = ht.observability.collective_counts(lambda a: ht.linalg.qr(a), x)
+    assert rep.all_gather == 1 and rep.total == 1
+
+``collective_counts`` lowers and compiles the function for the given
+example arguments (DNDarray arguments are traced through the same
+machinery as ``ht.jit``; already-jitted jax callables lower directly),
+then reports per-collective op counts, an estimated byte volume per
+collective kind parsed from the result shapes in the module text, and
+the compiler's own ``cost_analysis()`` aggregates. Nothing executes on
+device — inspection is compile-only, so it is cheap enough for tests
+and safe on any mesh (including the forced-CPU test mesh).
+"""
+
+from __future__ import annotations
+
+import re
+
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["COLLECTIVE_OPS", "CollectiveReport", "collective_counts"]
+
+COLLECTIVE_OPS = (
+    "all-gather",
+    "all-reduce",
+    "all-to-all",
+    "collective-permute",
+    "reduce-scatter",
+)
+
+# HLO dtype token -> itemsize, for the byte estimate
+_ITEMSIZE = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+
+# "f32[8,960,960]" / "u32[]" result-type tokens
+_SHAPED = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+# "  %x = <result-type> all-gather(" — result type is everything between
+# '=' and the op name (a bare shaped type or a tuple of them)
+_COLLECTIVE_LINE = re.compile(
+    r"=\s*([^=]*?)\s*(" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?\("
+)
+
+
+class CollectiveReport:
+    """Per-kind collective counts + byte estimates of one compiled module.
+
+    Attributes
+    ----------
+    counts : dict — {op name: count} over ``COLLECTIVE_OPS`` (async
+        start/done pairs count once, via their ``-start`` form).
+    bytes_by_op : dict — estimated output bytes per collective kind,
+        summed from the result shapes in the module text (an estimate:
+        async forms carry operand aliases in their result tuples).
+    flops / bytes_accessed : compiler ``cost_analysis()`` aggregates for
+        the WHOLE program, when the backend reports them (else None).
+    hlo_text : the compiled module text, for ad-hoc inspection.
+    """
+
+    def __init__(self, counts, bytes_by_op, flops, bytes_accessed, hlo_text):
+        self.counts: Dict[str, int] = counts
+        self.bytes_by_op: Dict[str, int] = bytes_by_op
+        self.flops: Optional[float] = flops
+        self.bytes_accessed: Optional[float] = bytes_accessed
+        self.hlo_text: str = hlo_text
+
+    @property
+    def total(self) -> int:
+        """Total collective op count."""
+        return sum(self.counts.values())
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.bytes_by_op.values())
+
+    # attribute sugar: rep.all_gather / rep.collective_permute ...
+    def __getattr__(self, name: str):
+        # read via __dict__: during unpickle/deepcopy this runs before
+        # __init__, and touching self.counts would recurse
+        counts = self.__dict__.get("counts")
+        if counts is not None:
+            key = name.replace("_", "-")
+            if key in counts:
+                return counts[key]
+        raise AttributeError(name)
+
+    def as_dict(self) -> Dict[str, Any]:
+        """JSON-ready summary (HLO text omitted)."""
+        return {
+            "counts": dict(self.counts),
+            "total": self.total,
+            "bytes_by_op": dict(self.bytes_by_op),
+            "total_bytes": self.total_bytes,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+        }
+
+    def __repr__(self) -> str:
+        nz = {k: v for k, v in self.counts.items() if v}
+        return f"CollectiveReport({nz or 'no collectives'}, ~{self.total_bytes} B)"
+
+
+def _shaped_bytes(type_str: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPED.findall(type_str):
+        if dtype == "token":
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _ITEMSIZE.get(dtype, 4)
+    return total
+
+
+def _count_ops(text: str) -> Dict[str, int]:
+    # " op(" catches sync forms, "op-start(" the async ones; the paired
+    # "-done" is not counted (one collective, not two)
+    return {
+        op: text.count(f" {op}(") + text.count(f"{op}-start(") for op in COLLECTIVE_OPS
+    }
+
+
+def _collective_bytes(text: str) -> Dict[str, int]:
+    out = {op: 0 for op in COLLECTIVE_OPS}
+    for m in _COLLECTIVE_LINE.finditer(text):
+        out[m.group(2)] += _shaped_bytes(m.group(1))
+    return out
+
+
+def _normalize_cost(compiled):
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None, None
+    if isinstance(cost, (list, tuple)):  # older jax returned [dict]
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return None, None
+    return cost.get("flops"), cost.get("bytes accessed")
+
+
+def _compile(fn: Callable, args: tuple, kwargs: dict):
+    """Lower + compile ``fn`` for the example ``args`` without executing.
+
+    jax-level callables that already expose ``.lower`` (jax.jit /
+    shard_map programs) lower directly. Everything else — notably public
+    heat_tpu functions over DNDarrays — goes through the same
+    trace-to-one-program machinery as ``ht.jit``: DNDarray leaves feed
+    their physical arrays as traced inputs, metadata rebuilds at trace
+    time, outputs flatten back to physical leaves."""
+    import jax
+
+    from ..core.dndarray import DNDarray
+    from ..core.jit import _is_leaf
+
+    leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_leaf)
+    if not any(isinstance(leaf, DNDarray) for leaf in leaves) and hasattr(fn, "lower"):
+        return fn.lower(*args, **kwargs).compile()
+
+    is_traced = [isinstance(leaf, (DNDarray, jax.Array)) for leaf in leaves]
+    metas = [
+        (leaf.gshape, leaf.dtype, leaf.split, leaf.device, leaf.comm)
+        if isinstance(leaf, DNDarray)
+        else None
+        for leaf in leaves
+    ]
+
+    def inner(*traced):
+        it = iter(traced)
+        rebuilt = []
+        for leaf, traced_leaf, meta in zip(leaves, is_traced, metas):
+            if not traced_leaf:
+                rebuilt.append(leaf)
+            elif meta is not None:
+                rebuilt.append(DNDarray(next(it), *meta))
+            else:
+                rebuilt.append(next(it))
+        a, kw = jax.tree.unflatten(treedef, rebuilt)
+        res = fn(*a, **kw)
+        out_leaves, _ = jax.tree.flatten(res, is_leaf=_is_leaf)
+        return tuple(
+            o._phys if isinstance(o, DNDarray) else o for o in out_leaves
+        )
+
+    traced_in = [
+        leaf._phys if isinstance(leaf, DNDarray) else leaf
+        for leaf, t in zip(leaves, is_traced)
+        if t
+    ]
+    return jax.jit(inner).lower(*traced_in).compile()
+
+
+def collective_counts(fn: Callable, *args, **kwargs) -> CollectiveReport:
+    """Compile ``fn(*args, **kwargs)`` and count its collective ops.
+
+    ``fn`` may be a public heat_tpu function over DNDarrays, an
+    ``ht.jit``/plain function, or an already-jitted jax callable; the
+    arguments are example inputs fixing shapes/shardings. Returns a
+    :class:`CollectiveReport` — e.g. TSQR at p < 16 reports exactly one
+    ``all-gather`` and nothing else, the hSVD level-0 sketch reports
+    zero collectives (the pinned contracts in tests/ and the MULTICHIP
+    dryrun). Compile-only: no device execution, no data read.
+    """
+    compiled = _compile(fn, args, kwargs)
+    text = compiled.as_text()
+    flops, bytes_accessed = _normalize_cost(compiled)
+    return CollectiveReport(
+        counts=_count_ops(text),
+        bytes_by_op=_collective_bytes(text),
+        flops=flops,
+        bytes_accessed=bytes_accessed,
+        hlo_text=text,
+    )

@@ -1,0 +1,98 @@
+"""Hook helpers for instrumenting program caches and hot paths.
+
+The op machinery compiles one XLA program per (op, shape, dtype, split)
+configuration and memoizes it in ``functools.lru_cache``-wrapped
+builders (``core/_operations.py``). Whether a dispatch hit that cache —
+and how long a miss took to build and first-execute (the XLA compile) —
+is exactly the signal a perf investigation needs first, so
+``observed_program_cache`` wraps those builders:
+
+- disabled telemetry: one bool check, then straight into the cached
+  builder — the hot path stays a dict lookup;
+- enabled: cache_info deltas classify hit vs miss; a miss records the
+  builder wall time and returns a one-shot proxy that times the FIRST
+  invocation of the program (where jax.jit actually traces + XLA
+  compiles) under ``<name>.compile``.
+
+The wrapper preserves ``cache_clear``/``cache_info`` so
+``register_mesh_cache`` and tests keep working on the wrapped object.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+from typing import Callable
+
+from . import events as _events
+from . import telemetry as _telemetry
+
+__all__ = ["nbytes_of", "observed_program_cache"]
+
+
+def nbytes_of(shape, dtype) -> int:
+    """Static byte size of an array from metadata only (trace-safe: never
+    touches the buffer)."""
+    import numpy as np
+
+    n = 1
+    for s in shape:
+        n *= int(s)
+    try:
+        return n * np.dtype(dtype).itemsize
+    except TypeError:
+        return n * 4
+
+
+class _TimedFirstCall:
+    """Proxy over a freshly built jitted program: the first call — where
+    trace + XLA compile happen — is timed under ``<name>.compile``."""
+
+    __slots__ = ("_name", "_prog")
+
+    def __init__(self, name: str, prog: Callable):
+        self._name = name
+        self._prog = prog
+
+    def __call__(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        out = self._prog(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        _telemetry.observe(f"{self._name}.compile", dt)
+        _events.emit("program_compile", cache=self._name, seconds=round(dt, 6))
+        return out
+
+    def __getattr__(self, attr):  # lower()/etc. pass through untimed
+        return getattr(self._prog, attr)
+
+
+def observed_program_cache(name: str):
+    """Decorator for an ``functools.lru_cache``-wrapped program builder:
+    counts ``<name>.hit`` / ``<name>.miss``, times the builder on a miss
+    (``<name>.build``) and the program's first execution
+    (``<name>.compile``). No-op passthrough while telemetry is off —
+    programs built then are never retro-instrumented."""
+
+    def deco(cached):
+        @functools.wraps(cached)
+        def wrapper(*args, **kwargs):
+            if not _telemetry._ENABLED:
+                return cached(*args, **kwargs)
+            misses_before = cached.cache_info().misses
+            t0 = time.perf_counter()
+            prog = cached(*args, **kwargs)
+            build_s = time.perf_counter() - t0
+            if cached.cache_info().misses > misses_before:
+                _telemetry.inc(f"{name}.miss")
+                _telemetry.observe(f"{name}.build", build_s)
+                return _TimedFirstCall(name, prog)
+            _telemetry.inc(f"{name}.hit")
+            return prog
+
+        wrapper.cache_clear = cached.cache_clear
+        wrapper.cache_info = cached.cache_info
+        wrapper.__wrapped__ = cached
+        return wrapper
+
+    return deco

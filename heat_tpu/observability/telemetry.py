@@ -1,0 +1,260 @@
+"""Structured runtime metrics core.
+
+The reference instruments its continuous benchmarks EXTERNALLY (perun
+``@monitor()`` decorators around benchmark scripts, HeAT paper 2007.13552);
+the library itself cannot answer "how many collectives did this op launch,
+how many bytes did that reshard move, did the program cache hit?" — even
+though redistribution cost is exactly what dominates at scale (2112.01075).
+This module is the first-party answer: a process-wide registry of
+
+- **counters** (monotonic ints: cache hits/misses, reshard calls, bytes
+  accounted via the ``*.bytes`` convention),
+- **timers** (count / total / min / max plus a bounded sample reservoir
+  for p50/p95),
+
+fed by hook points in the hot layers (``core/_operations.py``,
+``core/communication.py``, ``core/dndarray.py``, ``core/jit.py``) and by
+the ``record()`` context manager for user-scoped blocks.
+
+Design constraints, in order:
+
+1. **Zero-cost when disabled.** Every hook gates on the module-level
+   ``_ENABLED`` bool (one attribute read); no allocation, no lock, no
+   string formatting happens on the disabled path. The default is
+   disabled; ``HEAT_TPU_TELEMETRY=1`` in the environment enables at
+   import, ``enable()``/``disable()`` switch at runtime.
+2. **Trace-safe.** Hooks record only host-side Python values — shapes,
+   splits, dtypes, wall times — never array *values*, so they are safe
+   to hit inside a ``jax.jit``/``ht.jit`` trace (they then fire once per
+   compile, not per execution; events carry a ``traced`` field where the
+   distinction matters).
+3. **Thread-safe.** One lock around registry mutation; the reservoir is
+   bounded so memory stays O(#metrics).
+
+Energy note (perun-parity deviation): this platform exposes no
+in-container energy counter, so the registry records time/bytes/counts
+only — see ``heat_tpu.utils.monitor`` for the TDP-envelope estimation
+recipe.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+
+from typing import Any, Dict, Iterator, Optional
+
+__all__ = [
+    "Registry",
+    "disable",
+    "enable",
+    "enabled",
+    "export_jsonl",
+    "inc",
+    "observe",
+    "record",
+    "report",
+    "reset",
+    "snapshot",
+]
+
+# reservoir size per timer: enough for stable p50/p95 on bench-scale call
+# counts without unbounded growth on hot-loop instrumentation
+_SAMPLE_CAP = 1024
+
+
+def _env_truthy(value: Optional[str]) -> bool:
+    return (value or "").strip().lower() in ("1", "true", "on", "yes")
+
+
+def _percentile(sorted_samples, q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list."""
+    if not sorted_samples:
+        return 0.0
+    idx = min(len(sorted_samples) - 1, max(0, int(round(q * (len(sorted_samples) - 1)))))
+    return sorted_samples[idx]
+
+
+class Registry:
+    """Counter + timer store. The module-level singleton backs the public
+    API; ``heat_tpu.utils.monitor`` holds its own always-on instance (the
+    decorator is explicit opt-in, independent of the global switch)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._timers: Dict[str, dict] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(n)
+
+    def observe(self, name: str, seconds: float) -> None:
+        seconds = float(seconds)
+        with self._lock:
+            ent = self._timers.get(name)
+            if ent is None:
+                ent = {
+                    "calls": 0,
+                    "total_s": 0.0,
+                    "min_s": float("inf"),
+                    "max_s": 0.0,
+                    "samples": collections.deque(maxlen=_SAMPLE_CAP),
+                }
+                self._timers[name] = ent
+            ent["calls"] += 1
+            ent["total_s"] += seconds
+            ent["min_s"] = min(ent["min_s"], seconds)
+            ent["max_s"] = max(ent["max_s"], seconds)
+            ent["samples"].append(seconds)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def timer_table(self) -> Dict[str, Dict[str, float]]:
+        """{name: {calls, total_s, best_s, mean_s, max_s, p50_s, p95_s}}."""
+        with self._lock:
+            items = [(k, dict(v), sorted(v["samples"])) for k, v in self._timers.items()]
+        table = {}
+        for name, ent, samples in items:
+            calls = ent["calls"]
+            table[name] = {
+                "calls": calls,
+                "total_s": ent["total_s"],
+                "best_s": ent["min_s"] if calls else 0.0,
+                "mean_s": ent["total_s"] / calls if calls else 0.0,
+                "max_s": ent["max_s"],
+                "p50_s": _percentile(samples, 0.50),
+                "p95_s": _percentile(samples, 0.95),
+            }
+        return table
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"counters": self.counters(), "timers": self.timer_table()}
+
+
+# ------------------------------------------------------------------ #
+# module-level singleton + enable switch                             #
+# ------------------------------------------------------------------ #
+_REGISTRY = Registry()
+
+# hooks read this attribute directly (one dict lookup + attribute read):
+# the whole disabled-path cost of the instrumentation
+_ENABLED: bool = _env_truthy(os.environ.get("HEAT_TPU_TELEMETRY"))
+
+# record() nesting is per thread: names join with '/'
+_NESTING = threading.local()
+
+
+def enable() -> None:
+    """Turn telemetry collection on (also via ``HEAT_TPU_TELEMETRY=1``)."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable() -> None:
+    """Turn telemetry collection off. Collected data is kept until
+    ``reset()``."""
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def inc(name: str, n: int = 1) -> None:
+    """Increment counter ``name`` by ``n`` (no-op when disabled). Byte
+    accounting uses the same mechanism under a ``<name>.bytes`` key."""
+    if _ENABLED:
+        _REGISTRY.inc(name, n)
+
+
+def observe(name: str, seconds: float) -> None:
+    """Record one duration sample for timer ``name`` (no-op when
+    disabled)."""
+    if _ENABLED:
+        _REGISTRY.observe(name, seconds)
+
+
+@contextlib.contextmanager
+def record(name: str, **fields) -> Iterator[None]:
+    """Time the enclosed block under ``name`` and emit a structured event.
+
+    Nested ``record`` blocks compose their names with ``/``::
+
+        with ht.telemetry.record("ingest"):
+            with ht.telemetry.record("load"):   # timer key "ingest/load"
+                ...
+
+    ``fields`` become attributes of the emitted event (host-side values
+    only — the block may run jax work, the fields must not hold tracers).
+    A no-op (plain passthrough) when telemetry is disabled.
+    """
+    if not _ENABLED:
+        yield
+        return
+    stack = getattr(_NESTING, "stack", None)
+    if stack is None:
+        stack = _NESTING.stack = []
+    qualified = "/".join(stack + [name])
+    stack.append(name)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        stack.pop()
+        _REGISTRY.observe(qualified, dt)
+        from . import events as _events
+
+        _events.emit("record", name=qualified, seconds=round(dt, 9), **fields)
+
+
+def snapshot() -> Dict[str, Any]:
+    """Point-in-time copy of all counters and timer statistics."""
+    return _REGISTRY.snapshot()
+
+
+def report(as_json: bool = False) -> Any:
+    """Snapshot of counters + timer stats (p50/p95 included); with
+    ``as_json`` a JSON string."""
+    snap = snapshot()
+    return json.dumps(snap) if as_json else snap
+
+
+def reset() -> None:
+    """Clear all counters, timers and buffered events."""
+    _REGISTRY.clear()
+    from . import events as _events
+
+    _events.clear()
+
+
+def export_jsonl(path: str) -> int:
+    """Write the registry + event buffer as JSON lines (one object per
+    counter/timer/event) to ``path``; returns the number of lines."""
+    snap = snapshot()
+    from . import events as _events
+
+    lines = []
+    for name, value in sorted(snap["counters"].items()):
+        lines.append({"kind": "counter", "name": name, "value": value})
+    for name, stats in sorted(snap["timers"].items()):
+        lines.append({"kind": "timer", "name": name, **stats})
+    for ev in _events.snapshot():
+        lines.append({"kind": "event", **ev})
+    with open(path, "w") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+    return len(lines)

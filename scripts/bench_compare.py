@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Bench regression gate.
+
+VERDICT r5 caught an attention-MFU regression (0.68 -> 0.58 run-over-run)
+that nothing in the repo flagged: bench.py checks each run against
+PHYSICAL bounds, but nothing compared a run against the PREVIOUS run.
+This script closes that gap: it diffs the current bench record
+(``BENCH_DETAIL.json``) against a baseline (default: the highest-numbered
+``BENCH_r*.json`` driver artifact in the repo root), flags every shared
+metric that moved more than ``--threshold`` (default 10%) in the BAD
+direction without a ``measurement_suspect`` marker on either side, and
+emits ONE machine-readable verdict line plus ``BENCH_COMPARE.json`` —
+so a perf regression is caught at PR time instead of by the round judge.
+
+Exit code is 0 unless ``--strict`` is given and an unflagged regression
+was found (CI runs report-only; a bench-carrying PR should run
+``--strict``).
+
+Usage::
+
+    python scripts/bench_compare.py                 # auto-pick files
+    python scripts/bench_compare.py --strict        # gate (nonzero exit)
+    python scripts/bench_compare.py --baseline BENCH_r04.json --threshold 0.15
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# metric -> direction. Rows/fields not listed are informational and
+# never gate (method strings, passes_over_A, ordering_ok, ...).
+HIGHER_IS_BETTER = {
+    "value",
+    "vs_baseline",
+    "vs_torch_svd_lowrank",
+    "mfu",
+    "tflops",
+    "gbps",
+    "hbm_frac",
+    "hbm_frac_algorithmic",
+    "iter_per_s",
+    "projected_iter_per_s_1Bx64_v5e64",
+    "melem_per_s",
+    "speedup_vs_torch_cpu",
+    "speedup_vs_torch_svd_lowrank",
+}
+LOWER_IS_BETTER = {
+    "seconds",
+    "seconds_unrounded",
+    "eager_wallclock_s",
+    "overhead_vs_raw_jnp",
+    "overhead_vs_fused_jnp",
+    # the kernel-ring wrapper cost relative to bare splash: growth is a
+    # real regression (bench.py flags <0.9 samples as weather)
+    "vs_splash_row",
+}
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _rows_of(record: dict) -> dict:
+    """Normalize any of the three record shapes to {row: {field: num}}.
+
+    - BENCH_DETAIL.json: {"detail": {row: {...}}, "value": ...}
+    - driver BENCH_r0N.json: {"parsed": <compact line>} with
+      parsed.key_rows
+    - a compact line itself: {"key_rows": {...}, "value": ...}
+    """
+    if "parsed" in record and isinstance(record.get("parsed"), dict):
+        record = record["parsed"]
+    rows = {}
+    if isinstance(record.get("detail"), dict):
+        rows.update({k: dict(v) for k, v in record["detail"].items()})
+    elif isinstance(record.get("key_rows"), dict):
+        rows.update({k: dict(v) for k, v in record["key_rows"].items()})
+    if isinstance(record.get("value"), (int, float)):
+        rows["_headline"] = {"value": record["value"]}
+    return rows
+
+
+def _latest_round_artifact() -> str | None:
+    best, best_n = None, -1
+    for path in glob.glob(os.path.join(ROOT, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m and int(m.group(1)) > best_n:
+            best, best_n = path, int(m.group(1))
+    return best
+
+
+def compare(current: dict, baseline: dict, threshold: float) -> dict:
+    cur_rows, base_rows = _rows_of(current), _rows_of(baseline)
+    regressions, improvements, compared = [], [], 0
+    for row, base_fields in sorted(base_rows.items()):
+        cur_fields = cur_rows.get(row)
+        if cur_fields is None:
+            continue
+        suspect = bool(
+            cur_fields.get("measurement_suspect") or base_fields.get("measurement_suspect")
+        )
+        for field, base_val in sorted(base_fields.items()):
+            if field in HIGHER_IS_BETTER:
+                sign = 1.0
+            elif field in LOWER_IS_BETTER:
+                sign = -1.0
+            else:
+                continue
+            cur_val = cur_fields.get(field)
+            if not isinstance(cur_val, (int, float)) or not isinstance(base_val, (int, float)):
+                continue
+            if base_val == 0:
+                continue
+            compared += 1
+            # relative move in the GOOD direction (negative = got worse)
+            rel = sign * (cur_val - base_val) / abs(base_val)
+            entry = {
+                "row": row,
+                "field": field,
+                "baseline": base_val,
+                "current": cur_val,
+                "rel_change": round(rel, 4),
+            }
+            if rel < -threshold:
+                if suspect:
+                    entry["waived"] = "measurement_suspect"
+                regressions.append(entry)
+            elif rel > threshold:
+                improvements.append(entry)
+    gating = [r for r in regressions if "waived" not in r]
+    return {
+        "verdict": "regressed" if gating else "ok",
+        "threshold": threshold,
+        "compared": compared,
+        "regressions": regressions,
+        "improvements": improvements,
+    }
+
+
+def run(current_path=None, baseline_path=None, threshold=0.10, out_path=None) -> dict:
+    """Library entry (bench.py calls this after writing BENCH_DETAIL.json)."""
+    current_path = current_path or os.path.join(ROOT, "BENCH_DETAIL.json")
+    baseline_path = baseline_path or _latest_round_artifact()
+    if baseline_path is None or not os.path.exists(current_path):
+        return {
+            "verdict": "skipped",
+            "reason": "missing bench artifacts",
+            "current": current_path,
+            "baseline": baseline_path,
+        }
+    result = compare(_load(current_path), _load(baseline_path), threshold)
+    result["current_file"] = os.path.relpath(current_path, ROOT)
+    result["baseline_file"] = os.path.relpath(baseline_path, ROOT)
+    if out_path is None:
+        out_path = os.path.join(ROOT, "BENCH_COMPARE.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--current", default=None, help="bench record (default BENCH_DETAIL.json)")
+    ap.add_argument(
+        "--baseline", default=None, help="baseline record (default: latest BENCH_r*.json)"
+    )
+    ap.add_argument("--threshold", type=float, default=0.10, help="relative move that gates")
+    ap.add_argument(
+        "--strict", action="store_true", help="exit 1 on an unflagged regression"
+    )
+    args = ap.parse_args()
+    result = run(args.current, args.baseline, args.threshold)
+    # one compact machine-readable line on stdout (details in BENCH_COMPARE.json)
+    compact = {
+        "verdict": result["verdict"],
+        "threshold": result.get("threshold"),
+        "compared": result.get("compared"),
+        "regressed": [
+            f"{r['row']}.{r['field']}" for r in result.get("regressions", []) if "waived" not in r
+        ],
+        "waived": [
+            f"{r['row']}.{r['field']}" for r in result.get("regressions", []) if "waived" in r
+        ],
+        "improved": [f"{r['row']}.{r['field']}" for r in result.get("improvements", [])],
+        "baseline_file": result.get("baseline_file") or result.get("baseline"),
+    }
+    print(json.dumps(compact))
+    return 1 if (args.strict and result["verdict"] == "regressed") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

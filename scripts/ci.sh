@@ -14,8 +14,15 @@
 #     p-divisibility assumption degenerate differently — with the slow
 #     marks and the (process-spawning, mesh-size-independent)
 #     multiprocess worlds excluded;
-#  3. the multi-chip dryrun: the full training step jit-compiled and
-#     executed on an 8-device mesh (real dp/sp shardings).
+#  3. the telemetry-enabled smoke leg: the instrumentation hooks
+#     (program-cache counters, shard/reshard events, ht.jit tracing)
+#     must add NO failures when live — the zero-cost-when-disabled
+#     default is covered by every other leg running with them off;
+#  4. the multi-chip dryrun: the full training step jit-compiled and
+#     executed on an 8-device mesh (real dp/sp shardings);
+#  5. the bench regression gate, whenever bench artifacts exist
+#     (report-only here: BENCH_COMPARE.json + one verdict line; a
+#     bench-carrying change gates itself via --strict).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -24,5 +31,11 @@ python -m pytest tests/ -q "$@"
 XLA_FLAGS="--xla_force_host_platform_device_count=5" \
   python -m pytest tests/ -q -m "not slow" --ignore tests/test_multiprocess.py "$@"
 
+HEAT_TPU_TELEMETRY=1 python -m pytest tests/test_smoke.py tests/test_observability.py -q "$@"
+
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): OK')"
+
+if [ -f BENCH_DETAIL.json ] && ls BENCH_r*.json >/dev/null 2>&1; then
+  python scripts/bench_compare.py
+fi

@@ -56,6 +56,10 @@ def _run_world(tmp_path, nprocs: int, local_devices: int, timeout: int = 420):
             if p.poll() is None:
                 p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 and "Multiprocess computations aren't implemented" in out:
+            # capability gate, not a code failure: jaxlib 0.4.x cannot run
+            # multi-process worlds on the CPU backend (newer runtimes can)
+            pytest.skip("runtime's CPU backend lacks multiprocess support")
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert f"[p{i}] MULTIHOST_OK" in out
 

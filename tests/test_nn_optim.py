@@ -547,6 +547,22 @@ class TestRingKernelAttention:
             comm.mesh, comm.axis_name, S, S, self.B, self.H,
             self.D, True, scale, "float32", True,
         )
+        if kprog is None:
+            # capability gate, not a regression: older splash kernels
+            # demand head_dim % 128 == 0 and refuse this D=64 signature
+            # (dispatch then falls back to the blocked XLA ring). Probe
+            # the kernel directly so a real program-build break on a
+            # capable runtime still fails loudly.
+            import jax.numpy as jnp
+
+            fns = att._build_splash_mha(
+                self.H, 128, 128, False, scale, 128, 128, True, True
+            )
+            shp = jax.ShapeDtypeStruct((self.B, self.H, 128, self.D), jnp.float32)
+            try:
+                jax.eval_shape(fns, shp, shp, shp)
+            except NotImplementedError as e:
+                pytest.skip(f"runtime splash kernel cannot serve D={self.D}: {e}")
         assert kprog is not None
         txt = kprog.as_text()
         n_pp = txt.count(" collective-permute(") + txt.count("collective-permute-start(")
@@ -752,7 +768,9 @@ class TestConvLayers:
             ref = torch.nn.functional.avg_pool2d(
                 torch.from_numpy(x), k, stride=s
             ).numpy()
-            np.testing.assert_allclose(got, ref, rtol=1e-6)
+            # atol: reduce_window may sum the window in a different order
+            # than torch — near-zero outputs can differ by an ULP or two
+            np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
 
     def test_dropout2d_channelwise(self):
         import jax

@@ -1,0 +1,281 @@
+"""Observability subsystem: telemetry registry semantics (enable/disable,
+nesting, thread safety), the core-layer instrumentation hooks, the
+``collective_counts`` HLO inspector (promoting the MULTICHIP dryrun's
+collective pins into tier-1), and the ``utils.monitor`` compat shim."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+
+from heat_tpu.observability import events, telemetry
+
+from test_suites.basic_test import TestCase
+
+P = len(jax.devices())
+
+
+class TelemetryCase(TestCase):
+    """Every test leaves the global switch off and the registry empty."""
+
+    def setUp(self):
+        telemetry.disable()
+        telemetry.reset()
+
+    def tearDown(self):
+        telemetry.disable()
+        telemetry.reset()
+
+
+class TestTelemetryRegistry(TelemetryCase):
+    def test_disabled_is_noop(self):
+        self.assertFalse(telemetry.enabled())
+        telemetry.inc("x")
+        telemetry.observe("t", 0.5)
+        with telemetry.record("blk"):
+            pass
+        snap = telemetry.snapshot()
+        self.assertEqual(snap["counters"], {})
+        self.assertEqual(snap["timers"], {})
+        self.assertEqual(events.snapshot(), [])
+
+    def test_enable_disable_counters(self):
+        telemetry.enable()
+        self.assertTrue(telemetry.enabled())
+        telemetry.inc("c")
+        telemetry.inc("c", 4)
+        telemetry.disable()
+        telemetry.inc("c")  # dropped
+        self.assertEqual(telemetry.snapshot()["counters"]["c"], 5)
+
+    def test_timer_stats_and_percentiles(self):
+        telemetry.enable()
+        for ms in range(1, 101):  # 1..100 ms
+            telemetry.observe("t", ms / 1000.0)
+        stats = telemetry.snapshot()["timers"]["t"]
+        self.assertEqual(stats["calls"], 100)
+        self.assertAlmostEqual(stats["best_s"], 0.001)
+        self.assertAlmostEqual(stats["max_s"], 0.100)
+        self.assertAlmostEqual(stats["mean_s"], 0.0505)
+        self.assertAlmostEqual(stats["p50_s"], 0.051, delta=0.002)
+        self.assertAlmostEqual(stats["p95_s"], 0.095, delta=0.002)
+
+    def test_record_nesting_joins_names(self):
+        telemetry.enable()
+        with telemetry.record("outer", tag="a"):
+            with telemetry.record("inner"):
+                pass
+        timers = telemetry.snapshot()["timers"]
+        self.assertIn("outer", timers)
+        self.assertIn("outer/inner", timers)
+        names = [e["name"] for e in events.snapshot() if e["event"] == "record"]
+        self.assertEqual(names, ["outer/inner", "outer"])  # inner closes first
+
+    def test_thread_safety_smoke(self):
+        telemetry.enable()
+
+        def worker():
+            for _ in range(1000):
+                telemetry.inc("threads.c")
+                telemetry.observe("threads.t", 1e-6)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = telemetry.snapshot()
+        self.assertEqual(snap["counters"]["threads.c"], 8000)
+        self.assertEqual(snap["timers"]["threads.t"]["calls"], 8000)
+
+    def test_export_jsonl(self):
+        import tempfile
+
+        telemetry.enable()
+        telemetry.inc("e.c", 3)
+        telemetry.observe("e.t", 0.25)
+        with telemetry.record("e.blk"):
+            pass
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "telemetry.jsonl")
+            n = telemetry.export_jsonl(path)
+            with open(path) as f:
+                lines = [json.loads(line) for line in f]
+        self.assertEqual(len(lines), n)
+        kinds = {line["kind"] for line in lines}
+        self.assertEqual(kinds, {"counter", "timer", "event"})
+        counter = next(l for l in lines if l["kind"] == "counter" and l["name"] == "e.c")
+        self.assertEqual(counter["value"], 3)
+        timer = next(l for l in lines if l["kind"] == "timer" and l["name"] == "e.t")
+        self.assertIn("p95_s", timer)
+
+    def test_env_var_activation_parse(self):
+        for val in ("1", "true", "ON", "yes"):
+            self.assertTrue(telemetry._env_truthy(val))
+        for val in (None, "", "0", "false", "off"):
+            self.assertFalse(telemetry._env_truthy(val))
+
+    def test_event_buffer_is_bounded(self):
+        telemetry.enable()
+        for i in range(events.capacity() + 50):
+            events.emit("flood", i=i)
+        buffered = events.snapshot()
+        self.assertEqual(len(buffered), events.capacity())
+        self.assertEqual(buffered[-1]["i"], events.capacity() + 49)
+
+    def test_report_json_roundtrip(self):
+        telemetry.enable()
+        telemetry.inc("r.c")
+        parsed = json.loads(telemetry.report(as_json=True))
+        self.assertEqual(parsed["counters"]["r.c"], 1)
+
+
+class TestInstrumentationHooks(TelemetryCase):
+    def test_op_cache_hit_miss_counters(self):
+        telemetry.enable()
+        # unusual shape so the binary program cache cannot already hold it
+        a = ht.arange(9973, split=0).astype(ht.float32)
+        _ = a + 2.0
+        c = telemetry.snapshot()["counters"]
+        base_miss = c.get("op.binary.miss", 0)
+        self.assertGreaterEqual(base_miss, 1)
+        _ = a + 3.0  # same (op, shape, dtype, split): must hit
+        c = telemetry.snapshot()["counters"]
+        self.assertGreaterEqual(c.get("op.binary.hit", 0), 1)
+        self.assertEqual(c.get("op.binary.miss", 0), base_miss)
+        # the miss recorded build + first-execution (compile) timers
+        timers = telemetry.snapshot()["timers"]
+        self.assertIn("op.binary.build", timers)
+        self.assertIn("op.binary.compile", timers)
+
+    def test_reshard_event_and_bytes(self):
+        telemetry.enable()
+        data = np.arange(60, dtype=np.float32).reshape(10, 6)
+        x = ht.array(data, split=0)
+        y = x.resplit(1)
+        self.assert_array_equal(y, data)
+        snap = telemetry.snapshot()["counters"]
+        self.assertGreaterEqual(snap.get("dndarray.resplit.calls", 0), 1)
+        self.assertGreaterEqual(snap.get("comm.reshard.calls", 0), 1)
+        self.assertGreaterEqual(snap.get("comm.reshard.bytes", 0), data.nbytes)
+        ev = [e for e in events.snapshot() if e["event"] == "comm.reshard"]
+        self.assertTrue(ev)
+        self.assertEqual(ev[-1]["old_split"], 0)
+        self.assertEqual(ev[-1]["new_split"], 1)
+        self.assertEqual(ev[-1]["bytes_moved"], data.nbytes)
+        rev = [e for e in events.snapshot() if e["event"] == "dndarray.resplit"]
+        self.assertEqual(rev[-1]["in_place"], False)
+
+    def test_htjit_cache_counters_and_compile_timer(self):
+        telemetry.enable()
+        fused = ht.jit(lambda v: ht.exp(ht.sin(v) * 2.0 + v))
+        x = ht.arange(1009, split=0).astype(ht.float32)
+        fused(x)
+        fused(x)
+        c = telemetry.snapshot()["counters"]
+        self.assertEqual(c.get("ht.jit.cache.miss", 0), 1)
+        self.assertEqual(c.get("ht.jit.cache.hit", 0), 1)
+        self.assertIn("ht.jit.compile", telemetry.snapshot()["timers"])
+
+    def test_monitor_compat_shim(self):
+        from heat_tpu.utils import monitor as mon
+
+        mon.reset()
+
+        @mon.monitor()
+        def workload():
+            return ht.sum(ht.ones((8,), split=0))
+
+        for _ in range(3):
+            workload()
+        table = mon.report()
+        self.assertEqual(table["workload"]["calls"], 3)
+        for key in ("total_s", "best_s", "mean_s", "p50_s", "p95_s"):
+            self.assertIn(key, table["workload"])
+        self.assertGreaterEqual(table["workload"]["p95_s"], table["workload"]["p50_s"])
+        self.assertEqual(json.loads(mon.report(as_json=True))["workload"]["calls"], 3)
+        mon.reset()
+        self.assertEqual(mon.report(), {})
+        # with the global switch on, @monitor mirrors into the registry
+        telemetry.enable()
+        workload()
+        self.assertIn("monitor.workload", telemetry.snapshot()["timers"])
+
+
+class TestCollectiveCounts(TelemetryCase):
+    """The public form of the dryrun/HLO collective pins: TSQR moves
+    exactly ONE all-gather (p < 16 flat schedule), the hSVD level-0 block
+    sketch moves NOTHING (every ICI byte of the merge is that
+    all-gather). docs/PERF.md's cost model cites these counts."""
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_tsqr_exactly_one_allgather(self):
+        a = ht.random.randn(16 * P, 2 * P, split=0)
+        rep = ht.observability.collective_counts(lambda x: ht.linalg.qr(x), a)
+        self.assertEqual(rep.counts["all-gather"], 1)
+        self.assertEqual(rep.total, 1)  # and nothing else
+        # the gathered buffer is the (p, K, K) R stack: p * K^2 * 4 bytes
+        K = 2 * P
+        self.assertEqual(rep.bytes_by_op["all-gather"], P * K * K * 4)
+        self.assertEqual(rep.all_gather, 1)  # attribute sugar
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_hsvd_level0_zero_collectives(self):
+        from heat_tpu.core.linalg.svdtools import _local_svd_fn
+
+        comm = ht.get_comm()
+        m = 16
+        phys = comm.shard(jnp.ones((m, 4 * P), jnp.float32), 1)
+        fn = _local_svd_fn(comm.mesh, comm.axis_name, m, phys.shape[1] // P, 3, "float32", 5)
+        rep = ht.observability.collective_counts(fn, phys)  # .lower fast path
+        self.assertEqual(rep.total, 0)
+        self.assertEqual(rep.total_bytes, 0)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_sum_single_allreduce(self):
+        x = ht.arange(8 * P + 3, split=0).astype(ht.float32)
+        rep = ht.observability.collective_counts(lambda v: ht.sum(v), x)
+        self.assertEqual(rep.counts["all-reduce"], 1)
+        self.assertEqual(rep.total, 1)
+
+    def test_no_collectives_on_replicated_elementwise(self):
+        x = ht.ones((4, 4), split=None)
+        rep = ht.observability.collective_counts(lambda v: ht.exp(v), x)
+        self.assertEqual(rep.total, 0)
+
+    def test_report_dict_shape(self):
+        x = ht.ones((6,), split=0)
+        rep = ht.observability.collective_counts(lambda v: ht.sum(v), x)
+        d = rep.as_dict()
+        for key in ("counts", "total", "bytes_by_op", "total_bytes", "flops", "bytes_accessed"):
+            self.assertIn(key, d)
+        self.assertTrue(repr(rep).startswith("CollectiveReport("))
+        with self.assertRaises(AttributeError):
+            rep.not_a_collective
+
+    def test_compile_only_no_execution(self):
+        # inspection must not execute the program: an fn with a host-side
+        # side effect traced once is acceptable, but device buffers of the
+        # input must be left untouched (compile-only contract)
+        calls = []
+
+        def fn(v):
+            calls.append(1)  # trace-time only
+            return v * 2.0
+
+        x = ht.ones((5,), split=0)
+        ht.observability.collective_counts(fn, x)
+        self.assertEqual(len(calls), 1)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
