@@ -35,6 +35,7 @@ from .core import *
 from .core.linalg import *
 
 from . import core
+from . import analysis
 from . import classification
 from . import cluster
 from . import graph

@@ -1,0 +1,46 @@
+"""Static sharding / collective / host-sync analysis (``shardlint``).
+
+The north-star contract — compiled programs launch exactly the
+collectives the algorithm needs, every intermediate stays distributed,
+nothing round-trips through the host — is a *static* property of the
+traced program and the source tree. This package checks it before any
+TPU minute is spent, in two passes:
+
+- **Pass 1, IR lint** — :func:`ht.analysis.check(fn, *args) <check>`
+  walks the jaxpr and compiled StableHLO of any heat_tpu program
+  (reusing the ``ht.observability`` HLO walker) and reports implicit
+  reshards, replicated materializations, gather-fed reductions, dtype
+  widening, missed donations and host syncs as structured findings
+  with rule ids, severities and byte estimates.
+- **Pass 2, source lint** — :mod:`~heat_tpu.analysis.srclint` (CLI:
+  ``python scripts/lint.py heat_tpu/``) enforces repo invariants over
+  the tree itself: no undeclared ``jax.device_get``, no bare
+  ``jax.jit`` outside private program builders, public ops routed
+  through ``core/sanitation.py``.
+
+Legitimate host boundaries are declared, by name and category, in
+:mod:`~heat_tpu.analysis.boundaries` — the whitelist is code, reviewed
+like code, and tier-1 pins its exact ``core/`` population. Rule
+catalog and workflow: docs/PERF.md § Static analysis.
+"""
+
+from . import boundaries
+from . import findings
+from . import ircheck
+from . import srclint
+
+from .boundaries import HOST_BOUNDARIES, is_declared_sync
+from .findings import RULES, AnalysisReport, Finding
+from .ircheck import check
+from .srclint import lint_paths, lint_source
+
+__all__ = [
+    "AnalysisReport",
+    "Finding",
+    "HOST_BOUNDARIES",
+    "RULES",
+    "check",
+    "is_declared_sync",
+    "lint_paths",
+    "lint_source",
+]

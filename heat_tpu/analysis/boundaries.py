@@ -1,0 +1,138 @@
+"""Declared host boundaries — the whitelist the host-sync rules check.
+
+The repo invariant (rule SL201) is: device values never round-trip
+through the host inside library code, because one ``jax.device_get``
+serializes the dispatch pipeline and, in a multi-host world, reads only
+the addressable shards. Every legitimate sync must therefore be
+DECLARED here, in one reviewable file, in the category that states
+*why* it is allowed:
+
+- :data:`HOST_MODULES` — whole modules whose contract IS host transfer
+  (file I/O). Everything in them is exempt.
+- :data:`HOST_FUNCS` — functions whose API contract is to produce or
+  ingest a HOST value (``.numpy()`` export, ``__repr__``, host complex
+  assembly). Calling them eagerly is the point; they are unreachable
+  from traced code by construction (tracing them raises).
+- :data:`DATA_DEPENDENT_BOUNDARIES` — eager-only ops whose OUTPUT SHAPE
+  depends on data (``unique``/``nonzero`` counts, hSVD adaptive rank).
+  The host read is what makes the result shape concrete; these ops are
+  documented as untraceable (core/jit.py limitation #1).
+- :data:`HOST_BOUNDARIES` — the narrow category: a deliberate host
+  round-trip inside an otherwise traceable compute path. Each entry is
+  NAMED so tests can pin the exact population; tier-1 asserts the only
+  ``core/`` entry is ``percentile-q``. Adding a sync to a compute path
+  means adding a named entry here — the diff is the declaration.
+
+Matching is by (posix path suffix, dotted enclosing-scope qualname);
+line numbers are deliberately not part of a declaration so unrelated
+edits to a file do not invalidate it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+__all__ = [
+    "HOST_MODULES",
+    "HOST_FUNCS",
+    "DATA_DEPENDENT_BOUNDARIES",
+    "HOST_BOUNDARIES",
+    "is_declared_sync",
+]
+
+# modules that are host I/O by contract (posix path suffixes)
+HOST_MODULES: Tuple[str, ...] = (
+    "core/io.py",       # save/load: hyperslab writes are host-side by nature
+    "core/printing.py", # __str__ formatting renders on the host
+)
+
+# (path suffix, qualname) -> reason. Host-value producers/ingesters.
+HOST_FUNCS: Dict[Tuple[str, str], str] = {
+    ("core/dndarray.py", "DNDarray.__host_logical"): (
+        "the single funnel behind .numpy()/.item()/float(): its contract "
+        "is a host copy of the logical array"
+    ),
+    ("core/complex_planar.py", "host_complex"): (
+        "assembles a host numpy complex array from the device plane pair "
+        "(the planar analog of DNDarray.__host_logical)"
+    ),
+    ("core/complex_planar.py", "array_factory"): (
+        "ingestion: normalizes arbitrary host/device input to planes at "
+        "array-construction time (eager by definition)"
+    ),
+    ("sparse/dcsr_matrix.py", "DCSR_matrix.counts_displs_nnz"): (
+        "exports the per-device nnz partition as host ints (metadata "
+        "export API, the analog of the reference's counts/displs query)"
+    ),
+    ("sparse/dcsr_matrix.py", "DCSR_matrix.__repr__"): (
+        "debug rendering of the CSR triple on the host"
+    ),
+}
+
+# (path suffix, qualname) -> reason. Eager-only data-dependent-shape ops.
+DATA_DEPENDENT_BOUNDARIES: Dict[Tuple[str, str], str] = {
+    ("core/parallel.py", "_host_counts"): (
+        "unique/nonzero/compaction need the GLOBAL selected count on the "
+        "host to size their output arrays — the documented eager-only "
+        "boundary for data-dependent shapes"
+    ),
+    ("core/parallel.py", "distributed_unique"): (
+        "the merged-unique total sizes the result; shape is data"
+    ),
+    ("core/linalg/svdtools.py", "_hsvd_impl"): (
+        "adaptive-rank hSVD reads the singular values to choose the rank "
+        "the next merge level keeps — the rank IS data-dependent output "
+        "shape (reference svdtools.py truncates on the host identically)"
+    ),
+}
+
+# name -> (path suffix, qualname, reason). The NAMED whitelist: deliberate
+# syncs inside otherwise traceable compute paths. Keep this list short —
+# tier-1 pins its exact core/ population.
+HOST_BOUNDARIES: Dict[str, Tuple[str, str, str]] = {
+    "percentile-q": (
+        "core/statistics.py",
+        "percentile",
+        "q is read to the host ONCE so the two bracketing ranks per "
+        "percentile are static (they shape the program: two cross-shard "
+        "row fetches instead of a gather); a traced q is rejected with a "
+        "TypeError before this read",
+    ),
+}
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def is_declared_sync(path: str, qualname: str) -> Tuple[bool, str]:
+    """Is a host sync at (file, enclosing scope) declared?
+
+    Returns ``(declared, category-or-name)``. ``qualname`` is the dotted
+    enclosing-scope chain (``Class.method``, ``outer.inner``); a
+    declaration for ``outer`` covers syncs in its nested functions (the
+    boundary owns its helpers).
+    """
+    p = _norm(path)
+    for suffix in HOST_MODULES:
+        if p.endswith(suffix):
+            return True, f"host-module:{suffix}"
+    parts = qualname.split(".") if qualname else []
+    prefixes = {".".join(parts[: i + 1]) for i in range(len(parts))}
+
+    def _match(decls):
+        for (suffix, qn), _reason in decls.items():
+            if p.endswith(suffix) and (qn == qualname or qn in prefixes):
+                return qn
+        return None
+
+    qn = _match(HOST_FUNCS)
+    if qn:
+        return True, f"host-func:{qn}"
+    qn = _match(DATA_DEPENDENT_BOUNDARIES)
+    if qn:
+        return True, f"data-dependent:{qn}"
+    for name, (suffix, qn, _reason) in HOST_BOUNDARIES.items():
+        if p.endswith(suffix) and (qn == qualname or qn in prefixes):
+            return True, name
+    return False, ""

@@ -1,0 +1,140 @@
+"""Finding / report model shared by both analyzer passes.
+
+A finding is one violation of one rule at one site. IR findings
+(:mod:`~heat_tpu.analysis.ircheck`) anchor on a collective/equation in a
+compiled program and carry byte estimates; source findings
+(:mod:`~heat_tpu.analysis.srclint`) anchor on ``file:line``. Severity is
+the CI contract: ``error`` findings gate (``scripts/lint.py`` exits
+nonzero, the ci.sh leg fails), ``warning``/``info`` report only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SEVERITIES", "Finding", "AnalysisReport", "RULES"]
+
+SEVERITIES = ("error", "warning", "info")
+
+# rule id -> one-line contract. SL1xx = IR lint (compiled-program rules),
+# SL2xx = source lint (repo-invariant rules). docs/PERF.md carries the
+# narrative catalog; this dict is the machine-readable index the CLI and
+# tests key on.
+RULES: Dict[str, str] = {
+    "SL101": "implicit-reshard: a large operand crosses the mesh through an "
+             "all-to-all the algorithm did not ask for (input split disagrees "
+             "with the op's expected split)",
+    "SL102": "replicated-materialization: an all-gather materializes a "
+             "(near-)replicated copy of a sharded operand above the size "
+             "threshold",
+    "SL103": "allgather-feeds-reduction: an all-gather result is consumed by "
+             "a reduction — reduce-scatter (or a local reduce + small "
+             "all-reduce) moves O(1/p) of the bytes",
+    "SL104": "dtype-widening: a value is converted to a wider inexact dtype "
+             "than core/types.py promotion of the program inputs yields",
+    "SL105": "missed-donation: an output aliases an argument's shape/dtype "
+             "but the argument's buffer is not donated — the program holds "
+             "both copies live in HBM",
+    "SL106": "host-sync: the checked program reads device values on the host "
+             "(jax.device_get / .item() / .numpy() / float(...) on a device "
+             "value) — a round-trip that serializes the dispatch pipeline",
+    "SL201": "host-sync (library): jax.device_get outside a declared host "
+             "boundary (analysis/boundaries.py) — new syncs must be declared",
+    "SL202": "bare-jit: jax.jit outside a private program builder — public "
+             "surfaces must route through ht.jit so donation/telemetry hooks "
+             "apply",
+    "SL203": "unsanitized-public-op: a public op function does not route its "
+             "inputs through core/sanitation.py (or delegate to a routed op)",
+}
+
+
+class Finding:
+    """One rule violation.
+
+    Attributes
+    ----------
+    rule : str — rule id (key of :data:`RULES`).
+    severity : str — ``error`` | ``warning`` | ``info``.
+    message : str — human-readable, with the concrete site/op/bytes.
+    path / line : source anchor (source lint; ``None`` for IR findings).
+    op : the HLO op or jaxpr primitive the finding anchors on (IR lint).
+    nbytes : byte estimate of the flagged movement/materialization.
+    """
+
+    __slots__ = ("rule", "severity", "message", "path", "line", "op", "nbytes")
+
+    def __init__(self, rule, severity, message, path=None, line=None, op=None, nbytes=None):
+        if severity not in SEVERITIES:
+            raise ValueError(f"severity must be one of {SEVERITIES}, got {severity!r}")
+        self.rule: str = rule
+        self.severity: str = severity
+        self.message: str = message
+        self.path: Optional[str] = path
+        self.line: Optional[int] = line
+        self.op: Optional[str] = op
+        self.nbytes: Optional[int] = nbytes
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+            "path": self.path,
+            "line": self.line,
+            "op": self.op,
+            "nbytes": self.nbytes,
+        }
+
+    def __repr__(self) -> str:
+        where = f"{self.path}:{self.line}: " if self.path else ""
+        return f"[{self.rule}/{self.severity}] {where}{self.message}"
+
+
+class AnalysisReport:
+    """Findings of one analyzer run plus the context they were made in."""
+
+    def __init__(self, findings: List[Finding], context: Optional[Dict[str, Any]] = None):
+        self.findings: List[Finding] = list(findings)
+        self.context: Dict[str, Any] = dict(context or {})
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "warning"]
+
+    @property
+    def ok(self) -> bool:
+        """True when nothing gates (no error-severity findings)."""
+        return not self.errors
+
+    @property
+    def rule_ids(self) -> List[str]:
+        """Distinct rule ids present, sorted."""
+        return sorted({f.rule for f in self.findings})
+
+    def by_rule(self, rule: str) -> List[Finding]:
+        return [f for f in self.findings if f.rule == rule]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "rule_ids": self.rule_ids,
+            "findings": [f.as_dict() for f in self.findings],
+            "context": dict(self.context),
+        }
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def __len__(self) -> int:
+        return len(self.findings)
+
+    def __repr__(self) -> str:
+        n_err, n_warn = len(self.errors), len(self.warnings)
+        return (
+            f"AnalysisReport({len(self.findings)} findings: "
+            f"{n_err} error, {n_warn} warning; rules={self.rule_ids})"
+        )

@@ -1,0 +1,348 @@
+"""Pass 1: IR lint — ``ht.analysis.check(fn, *args)``.
+
+Traces and compiles ``fn`` for the example arguments exactly the way a
+real dispatch would (the :func:`~heat_tpu.observability.hlo` machinery:
+DNDarray leaves feed physical arrays, metadata rebuilds at trace time),
+then walks the jaxpr and the compiled StableHLO and emits structured
+:class:`~heat_tpu.analysis.findings.Finding`\\ s. Nothing executes on
+device — the whole pass is compile-only, cheap enough for tests and CI.
+
+The point (arxiv 2112.01075, arxiv 2112.09017): reshard cost is a
+static property of source/target shardings, and TPU-scale linear
+algebra lives or dies on every intermediate staying distributed — both
+are checkable *here*, before any TPU minute is spent. The rules:
+
+========  ========  ====================================================
+rule      severity  fires when
+========  ========  ====================================================
+SL101     warn/err  an all-to-all moves ≥ ``min_bytes`` (err when it
+                    moves ≥ ``replicate_frac`` of the largest input)
+SL102     warn/err  an all-gather materializes ≥ ``min_bytes`` (same
+                    escalation — a full-operand gather is an error)
+SL103     warning   an all-gather result feeds a ``reduce``
+SL104     warning   an inexact value widens past core/types.py
+                    promotion of the program inputs
+SL105     warning   an output aliases an argument's aval but the buffer
+                    is not donated (cross-checked against ht.jit's
+                    donation bookkeeping)
+SL106     error     the program syncs the host (seen in source, or the
+                    trace aborts on a concretization error); ambiguous
+                    ``int()``/``float()`` casts report as warnings
+========  ========  ====================================================
+
+The contracts the repo already pins stay clean by construction: TSQR's
+one p·K² R-stack all-gather and ring attention's two ppermutes sit far
+under ``min_bytes`` at any sane K, and the hSVD level-0 sketch compiles
+to zero collectives.
+"""
+
+from __future__ import annotations
+
+import re
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .findings import AnalysisReport, Finding
+
+__all__ = ["check"]
+
+
+
+def _nbytes(shape, dtype) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * np.dtype(dtype).itemsize
+
+
+def _effective_itemsize(dtype) -> int:
+    """Precision per real component: complex64 carries f32 precision."""
+    dt = np.dtype(dtype)
+    return dt.itemsize // 2 if dt.kind == "c" else dt.itemsize
+
+
+def _walk_jaxprs(jaxpr):
+    """Yield every eqn of ``jaxpr`` and its nested sub-jaxprs (pjit /
+    scan / cond / shard_map bodies)."""
+    from jax.extend import core as jex_core  # jaxpr types live here on 0.4.x
+
+    todo = [jaxpr]
+    seen = set()
+    while todo:
+        jx = todo.pop()
+        if id(jx) in seen:
+            continue
+        seen.add(id(jx))
+        for eqn in jx.eqns:
+            yield eqn
+            for val in eqn.params.values():
+                for sub in _as_jaxprs(val, jex_core):
+                    todo.append(sub)
+
+
+def _as_jaxprs(val, jex_core):
+    out = []
+    vals = val if isinstance(val, (list, tuple)) else (val,)
+    for v in vals:
+        closed = getattr(v, "jaxpr", None)
+        if closed is not None and hasattr(v, "consts"):  # ClosedJaxpr
+            out.append(closed)
+        elif hasattr(v, "eqns"):  # raw Jaxpr
+            out.append(v)
+    return out
+
+
+def _trace_errors():
+    import jax
+
+    return (
+        jax.errors.ConcretizationTypeError,
+        jax.errors.TracerArrayConversionError,
+        jax.errors.TracerBoolConversionError,
+        jax.errors.TracerIntegerConversionError,
+    )
+
+
+def _donated_avals(fn, args, donate_argnums) -> set:
+    """(shape, dtype-str) of every leaf of every donated positional arg.
+    Donation declared either to ``check`` directly or on an ``ht.jit``
+    wrapper (core/jit.py records its user-facing donate_argnums on the
+    wrapper — the cross-check that bookkeeping exists for)."""
+    import jax
+
+    from ..core.jit import _is_leaf
+
+    if donate_argnums is None:
+        donate_argnums = getattr(fn, "_ht_jit_donate_argnums", ())
+    if isinstance(donate_argnums, int):
+        donate_argnums = (donate_argnums,)
+    donated = set()
+    for u in donate_argnums:
+        if 0 <= u < len(args):
+            for leaf in jax.tree.leaves(args[u], is_leaf=_is_leaf):
+                phys = getattr(leaf, "_phys", leaf)  # DNDarray -> padded physical
+                shape = getattr(phys, "shape", None)
+                dtype = getattr(phys, "dtype", None)
+                if shape is not None and dtype is not None:
+                    donated.add((tuple(shape), str(np.dtype(dtype))))
+    return donated
+
+
+def check(
+    fn: Callable,
+    *args,
+    mesh=None,
+    min_bytes: int = 1 << 20,
+    replicate_frac: float = 0.5,
+    donate_argnums: Optional[Tuple[int, ...]] = None,
+    scan_source: bool = True,
+    **kwargs,
+) -> AnalysisReport:
+    """Statically analyze the program ``fn(*args, **kwargs)`` compiles to.
+
+    ``fn`` may be a public heat_tpu function over DNDarrays, an
+    ``ht.jit``-wrapped function, or an already-jitted jax callable; the
+    arguments are example inputs fixing shapes/shardings (same contract
+    as :func:`ht.observability.collective_counts`). Compile-only.
+
+    Parameters
+    ----------
+    mesh : optional ``jax.sharding.Mesh`` the program is meant for —
+        recorded in the report context (DNDarray arguments already carry
+        their mesh via their communicator).
+    min_bytes : collectives moving less than this are structural, not
+        findings (default 1 MiB — TSQR's R-stack gather passes clean).
+    replicate_frac : an all-gather/all-to-all moving at least this
+        fraction of the largest input escalates to ``error``.
+    donate_argnums : positional args whose buffers the caller donates at
+        dispatch time; defaults to the checked ``ht.jit`` wrapper's own
+        donation bookkeeping when present.
+    scan_source : also scan ``fn``'s source for host syncs hiding in
+        untaken branches (rule SL106).
+
+    Returns an :class:`AnalysisReport`; ``report.ok`` is False iff an
+    error-severity finding gates.
+    """
+    import jax
+
+    from ..observability.hlo import (
+        _COLLECTIVE_LINE,
+        _build_traceable,
+        _count_ops,
+        _shaped_bytes,
+    )
+
+    findings: List[Finding] = []
+    context: Dict[str, Any] = {"pass": "ircheck", "min_bytes": int(min_bytes)}
+    if mesh is not None:
+        context["mesh_devices"] = int(np.asarray(mesh.devices).size)
+
+    if scan_source:
+        from .srclint import scan_program_source
+
+        findings += scan_program_source(fn)
+
+    kind, target, traced_in = _build_traceable(fn, args, kwargs)
+    try:
+        if kind == "lower":
+            try:
+                closed = jax.make_jaxpr(target)(*args, **kwargs)
+            except TypeError:
+                # make_jaxpr traces EVERY argument; a jitted fn with
+                # static (non-array) args needs the AOT trace, which
+                # respects the jit's own static_argnums
+                closed = target.trace(*args, **kwargs).jaxpr
+            compiled = target.lower(*args, **kwargs).compile()
+        else:
+            closed = jax.make_jaxpr(target)(*traced_in)
+            # compile-only lowering of the CHECKED program — never
+            # dispatched, so ht.jit's hooks have nothing to observe here
+            compiled = jax.jit(target).lower(*traced_in).compile()  # shardlint: ignore[SL202]
+    except _trace_errors() as e:
+        findings.append(
+            Finding(
+                "SL106",
+                "error",
+                "trace aborted: the program reads device VALUES on the host "
+                f"(concretization) — {type(e).__name__}: {str(e).splitlines()[0]}",
+            )
+        )
+        return AnalysisReport(findings, context)
+    except TypeError as e:
+        if "ht.jit" in str(e) and "host" in str(e):
+            findings.append(
+                Finding("SL106", "error", f"trace aborted by a host read: {e}")
+            )
+            return AnalysisReport(findings, context)
+        raise
+
+    in_avals = [(tuple(a.shape), str(a.dtype)) for a in closed.in_avals]
+    out_avals = [(tuple(a.shape), str(a.dtype)) for a in closed.out_avals]
+    in_bytes = [_nbytes(s, d) for s, d in in_avals]
+    max_in = max(in_bytes, default=0)
+    context["max_input_bytes"] = int(max_in)
+    err_bytes = max(int(min_bytes), int(replicate_frac * max_in))
+
+    text = compiled.as_text()
+    context["collective_counts"] = {k: v for k, v in _count_ops(text).items() if v}
+
+    # ---- SL101 / SL102: large resharding collectives -------------------
+    gather_names: List[Tuple[str, int]] = []
+    for m in _COLLECTIVE_LINE.finditer(text):
+        ssa, result_type, op = m.group(1), m.group(2), m.group(3)
+        nbytes = _shaped_bytes(result_type)
+        if op == "all-gather":
+            gather_names.append((ssa, nbytes))
+        if op not in ("all-to-all", "all-gather") or nbytes < min_bytes:
+            continue
+        rule = "SL101" if op == "all-to-all" else "SL102"
+        severity = "error" if nbytes >= err_bytes else "warning"
+        what = (
+            "implicit reshard: an all-to-all relayouts"
+            if op == "all-to-all"
+            else "replicated materialization: an all-gather assembles"
+        )
+        findings.append(
+            Finding(
+                rule,
+                severity,
+                f"{what} ~{nbytes} B ({ssa}); largest input is {max_in} B — "
+                "align the operand's split with the op (resplit once, "
+                "upstream, or keep the intermediate distributed)",
+                op=op,
+                nbytes=nbytes,
+            )
+        )
+
+    # ---- SL103: all-gather feeding a reduction -------------------------
+    # consumer shapes differ by backend: a direct `reduce(`, the CPU
+    # `reduce-window` ladder, or a `call` into a %parallel_reduce-*
+    # computation — all carry a "reduce" token on the consuming line.
+    # metadata={op_name=...} trailers are stripped first: a consumer whose
+    # source location merely MENTIONS reduce is not a reduction, and a
+    # gather already feeding reduce-scatter needs no reduce-scatter advice
+    lines = [ln.split(" metadata=")[0] for ln in text.splitlines()]
+    for ssa, nbytes in gather_names:
+        operand = re.compile(re.escape(ssa) + r"(?![\w.\-])")
+        for line in lines:
+            if "reduce" not in line or "all-reduce" in line or "reduce-scatter" in line:
+                continue
+            lhs = line.strip().removeprefix("ROOT ").startswith(ssa)
+            if not lhs and operand.search(line):
+                findings.append(
+                    Finding(
+                        "SL103",
+                        "warning",
+                        f"all-gather result {ssa} (~{nbytes} B) feeds a "
+                        "reduction — a reduce-scatter (or local reduce + "
+                        "small all-reduce) moves O(1/p) of the bytes",
+                        op="all-gather",
+                        nbytes=nbytes,
+                    )
+                )
+                break
+
+    # ---- SL104: dtype widening beyond input promotion ------------------
+    inexact_in = [
+        _effective_itemsize(d) for _, d in in_avals if np.dtype(d).kind in "fc"
+    ]
+    ceiling = max(inexact_in, default=4)
+    seen_widen = set()
+    for eqn in _walk_jaxprs(closed.jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src_dt = np.dtype(eqn.invars[0].aval.dtype)
+        dst_dt = np.dtype(eqn.params.get("new_dtype"))
+        if src_dt.kind not in "fc" or dst_dt.kind not in "fc":
+            continue
+        src_w, dst_w = _effective_itemsize(src_dt), _effective_itemsize(dst_dt)
+        if dst_w > src_w and dst_w > ceiling and (src_dt.name, dst_dt.name) not in seen_widen:
+            seen_widen.add((src_dt.name, dst_dt.name))
+            findings.append(
+                Finding(
+                    "SL104",
+                    "warning",
+                    f"dtype widening {src_dt.name} -> {dst_dt.name}: wider "
+                    "than core/types.py promotion of any input "
+                    f"(ceiling {ceiling * 8}-bit) — likely an accidental "
+                    "64-bit constant or astype",
+                    op="convert_element_type",
+                )
+            )
+
+    # ---- SL105: aliasable output not donated ---------------------------
+    # with explicit donation bookkeeping the per-aval check below is the
+    # authority (a PARTIALLY donated program still has missed donations to
+    # report); only without it does module-level aliasing mean "the caller
+    # already donated through raw jax.jit" and silence the rule
+    donated = _donated_avals(fn, args, donate_argnums)
+    have_bookkeeping = bool(donated) or donate_argnums is not None
+    if have_bookkeeping or "input_output_alias" not in text:
+        in_set = set(in_avals)
+        flagged = set()
+        for shape, dtype in out_avals:
+            aval = (shape, dtype)
+            nbytes = _nbytes(shape, dtype)
+            if (
+                nbytes >= min_bytes
+                and aval in in_set
+                and aval not in donated
+                and aval not in flagged
+            ):
+                flagged.add(aval)
+                findings.append(
+                    Finding(
+                        "SL105",
+                        "warning",
+                        f"an output of shape {shape} {dtype} (~{nbytes} B) "
+                        "aliases an argument's aval but the buffer is not "
+                        "donated — pass donate_argnums to ht.jit so the "
+                        "pipeline reuses the input HBM",
+                        nbytes=nbytes,
+                    )
+                )
+
+    findings.sort(key=lambda f: ({"error": 0, "warning": 1, "info": 2}[f.severity], f.rule))
+    return AnalysisReport(findings, context)

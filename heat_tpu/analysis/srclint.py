@@ -1,0 +1,347 @@
+"""Pass 2: source lint — AST enforcement of repo invariants over
+``heat_tpu/`` itself.
+
+Three rules (catalog in :mod:`~heat_tpu.analysis.findings`):
+
+- **SL201 host-sync** — ``jax.device_get`` (the one primitive every
+  host read in this codebase funnels through: ``.numpy()``, ``float()``,
+  io writes all reach it) is an error outside a boundary declared in
+  :mod:`~heat_tpu.analysis.boundaries`. New syncs must be declared —
+  the declaration is the review artifact.
+- **SL202 bare-jit** — ``jax.jit`` is an error outside a *private
+  program builder*. The sanctioned idiom is: public surfaces route
+  through ``ht.jit`` (donation mapping, telemetry hooks, DNDarray
+  metadata) or ``comm.jit_sharded`` (output-sharding pins); raw
+  ``jax.jit`` lives only in ``_``-prefixed builder functions/modules
+  that those surfaces call.
+- **SL203 unsanitized-public-op** — a public function in a declared op
+  module must route its inputs through ``core/sanitation.py`` (call a
+  ``sanitize_*`` helper), delegate to the ``_operations`` wrappers
+  (which sanitize), or delegate to another routed op. Warning severity:
+  it reports drift, the error rules gate.
+
+Inline escape hatch (fixtures, justified one-offs)::
+
+    x = jax.device_get(v)  # shardlint: ignore[SL201] -- why it is fine
+
+A pragma on a ``def`` line covers the whole function.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import boundaries
+from .findings import AnalysisReport, Finding
+
+__all__ = ["lint_source", "lint_paths", "scan_program_source"]
+
+# modules where bare jax.jit is the implementation itself
+_BARE_JIT_MODULES = (
+    "core/jit.py",            # ht.jit IS the wrapper over jax.jit
+    "core/communication.py",  # jit_sharded_mesh, the sanctioned pin helper
+)
+
+# op modules whose public functions rule SL203 holds to the sanitation
+# contract (the reference's "every public op validates via sanitation.py")
+_OP_MODULES = (
+    "core/arithmetics.py",
+    "core/complex_math.py",
+    "core/exponential.py",
+    "core/logical.py",
+    "core/manipulations.py",
+    "core/relational.py",
+    "core/rounding.py",
+    "core/statistics.py",
+    "core/trigonometrics.py",
+)
+
+_PRAGMA = re.compile(r"#\s*shardlint:\s*ignore\[([A-Z0-9,\s*]+)\]")
+
+
+def _pragmas_of(src: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _PRAGMA.search(line)
+        if m:
+            out[i] = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+    return out
+
+
+def _call_name(func: ast.AST) -> str:
+    """Terminal name of a call target: ``jax.device_get`` -> device_get."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    )
+
+
+class _Scope:
+    __slots__ = ("stack", "def_lines")
+
+    def __init__(self, stack: Tuple[str, ...], def_lines: Tuple[int, ...]):
+        self.stack = stack
+        self.def_lines = def_lines
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self.stack)
+
+    def is_private(self) -> bool:
+        return any(part.startswith("_") for part in self.stack)
+
+
+def _walk_scoped(tree: ast.AST):
+    """Yield (node, scope) for every node, tracking the enclosing
+    function/class chain and the line numbers of the enclosing defs
+    (pragma anchors)."""
+    todo = [(tree, _Scope((), ()))]
+    while todo:
+        node, scope = todo.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                inner = _Scope(scope.stack + (child.name,), scope.def_lines + (child.lineno,))
+                yield child, scope  # the def itself belongs to the outer scope
+                todo.append((child, inner))
+            else:
+                yield child, scope
+                todo.append((child, scope))
+
+
+def _suppressed(rule: str, lineno: int, scope: _Scope, pragmas: Dict[int, Set[str]]) -> bool:
+    for anchor in (lineno,) + scope.def_lines:
+        rules = pragmas.get(anchor)
+        if rules and (rule in rules or "*" in rules):
+            return True
+    return False
+
+
+def _module_is_private(rel: str) -> bool:
+    return any(part.startswith("_") for part in rel.replace("\\", "/").split("/"))
+
+
+def _lint_sl203(tree: ast.Module, rel: str, pragmas) -> List[Finding]:
+    """Public op functions must sanitize or delegate to code that does."""
+    top_fns = {n.name for n in tree.body if isinstance(n, ast.FunctionDef)}
+    # names imported from sibling modules (`from .dndarray import DNDarray`,
+    # `from . import _operations`) — calling one is delegation to a routed
+    # surface
+    imported: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ImportFrom):
+            imported.update(a.asname or a.name for a in n.names)
+    findings: List[Finding] = []
+    for fn in tree.body:
+        if not isinstance(fn, ast.FunctionDef) or fn.name.startswith("_"):
+            continue
+        if _suppressed("SL203", fn.lineno, _Scope((fn.name,), (fn.lineno,)), pragmas):
+            continue
+        routed = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            if name.startswith("sanitize") or name == "scalar_to_1d":
+                routed = True
+                break
+            # _operations.__binary_op / __reduce_op / ... — the wrappers
+            # sanitize on entry
+            if (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "_operations"
+            ):
+                routed = True
+                break
+            # delegation to another routed surface: a sibling public op of
+            # this module, or any imported sibling helper/op
+            if isinstance(node.func, ast.Name) and (
+                node.func.id in top_fns or node.func.id in imported
+            ):
+                routed = True
+                break
+        if not routed:
+            findings.append(
+                Finding(
+                    "SL203",
+                    "warning",
+                    f"public op {fn.name!r} neither calls a sanitize_* helper "
+                    "nor delegates to a routed op (core/sanitation.py contract)",
+                    path=rel,
+                    line=fn.lineno,
+                )
+            )
+    return findings
+
+
+def lint_source(src: str, rel: str) -> List[Finding]:
+    """Lint one module's source. ``rel`` is the repo-relative posix path
+    (what declarations in boundaries.py and module allowlists match on).
+    """
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("SL201", "error", f"unparseable module: {e}", path=rel, line=e.lineno)]
+    pragmas = _pragmas_of(src)
+    rel = rel.replace("\\", "/")
+    findings: List[Finding] = []
+    module_private = _module_is_private(rel)
+    jit_module_ok = any(rel.endswith(sfx) for sfx in _BARE_JIT_MODULES)
+
+    for node, scope in _walk_scoped(tree):
+        # SL201 — host sync
+        if isinstance(node, ast.Call) and _call_name(node.func) == "device_get":
+            declared, _cat = boundaries.is_declared_sync(rel, scope.qualname)
+            if not declared and not _suppressed("SL201", node.lineno, scope, pragmas):
+                where = scope.qualname or "<module>"
+                findings.append(
+                    Finding(
+                        "SL201",
+                        "error",
+                        f"jax.device_get in {where} is not a declared host "
+                        "boundary — declare it in heat_tpu/analysis/"
+                        "boundaries.py (named HOST_BOUNDARIES entry for a "
+                        "compute-path sync) or mark the line with "
+                        "`# shardlint: ignore[SL201] -- reason`",
+                        path=rel,
+                        line=node.lineno,
+                    )
+                )
+        # SL202 — bare jax.jit (call, decorator, or bare reference alike)
+        if _is_jax_jit(node):
+            allowed = module_private or jit_module_ok or scope.is_private()
+            if not allowed and not _suppressed("SL202", node.lineno, scope, pragmas):
+                where = scope.qualname or "<module>"
+                findings.append(
+                    Finding(
+                        "SL202",
+                        "error",
+                        f"bare jax.jit in public scope {where} — route through "
+                        "ht.jit (donation/telemetry hooks) or move the program "
+                        "builder into a _-private function",
+                        path=rel,
+                        line=node.lineno,
+                    )
+                )
+        # `from jax import jit` hides the SL202 pattern from review
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "jit" and not _suppressed("SL202", node.lineno, scope, pragmas):
+                    findings.append(
+                        Finding(
+                            "SL202",
+                            "error",
+                            "`from jax import jit` aliases bare jax.jit past "
+                            "review — import jax and use a private builder, or "
+                            "use ht.jit",
+                            path=rel,
+                            line=node.lineno,
+                        )
+                    )
+
+    if any(rel.endswith(sfx) for sfx in _OP_MODULES):
+        findings += _lint_sl203(tree, rel, pragmas)
+    findings.sort(key=lambda f: (f.path or "", f.line or 0, f.rule))
+    return findings
+
+
+def _iter_py_files(path: str):
+    if os.path.isfile(path):
+        yield path
+        return
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def lint_paths(paths, root: Optional[str] = None) -> AnalysisReport:
+    """Lint every ``.py`` file under ``paths``; relative anchors are
+    computed against ``root`` (default: current directory)."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: List[Finding] = []
+    n_files = 0
+    for path in paths:
+        for fp in _iter_py_files(path):
+            n_files += 1
+            with open(fp, encoding="utf-8") as f:
+                src = f.read()
+            rel = os.path.relpath(os.path.abspath(fp), root).replace(os.sep, "/")
+            findings += lint_source(src, rel)
+    return AnalysisReport(findings, context={"files": n_files, "pass": "srclint"})
+
+
+# --------------------------------------------------------------------- #
+# user-program scan (pass 1 uses this on the checked fn's source)       #
+# --------------------------------------------------------------------- #
+
+_HOST_ATTR_CALLS = ("item", "numpy", "block_until_ready")
+
+
+def scan_program_source(fn) -> List[Finding]:
+    """Best-effort host-sync scan (rule SL106) of a checked program's
+    SOURCE — catches syncs the trace cannot see because they sit in an
+    untaken branch (a debug print, a logging arm). Silently returns []
+    when source is unavailable (builtins, compiled callables, REPL).
+    """
+    import inspect
+    import textwrap
+
+    target = inspect.unwrap(fn)
+    try:
+        src = textwrap.dedent(inspect.getsource(target))
+        tree = ast.parse(src)
+        base = inspect.getsourcefile(target) or "<source>"
+        first = target.__code__.co_firstlineno if hasattr(target, "__code__") else 1
+    except (TypeError, OSError, SyntaxError, AttributeError):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node, severity, what):
+        findings.append(
+            Finding(
+                "SL106",
+                severity,
+                f"{what} inside the checked program — a host round-trip "
+                "serializes dispatch and breaks tracing (run it eagerly, "
+                "outside, or behind a declared boundary)",
+                path=base,
+                line=first + node.lineno - 1,
+                op=what.split("(")[0],
+            )
+        )
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name == "device_get":
+            flag(node, "error", "jax.device_get(...)")
+        elif name in _HOST_ATTR_CALLS and isinstance(node.func, ast.Attribute):
+            flag(node, "error", f".{name}()")
+        elif name in ("float", "int", "bool") and node.args and isinstance(
+            node.args[0], (ast.Call, ast.Attribute)
+        ):
+            # heuristic: the AST cannot tell a device value from a host
+            # one (int(x.ndim) is fine), so casts report, never gate
+            flag(node, "warning", f"{name}(<maybe-device value>)")
+        elif name in ("asarray", "array") and isinstance(node.func, ast.Attribute) and (
+            isinstance(node.func.value, ast.Name) and node.func.value.id in ("np", "numpy")
+        ) and node.args and not isinstance(node.args[0], (ast.Constant, ast.List, ast.Tuple)):
+            flag(node, "warning", "np.asarray(<device value>)")
+    return findings

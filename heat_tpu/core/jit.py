@@ -335,4 +335,7 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
         return jax.tree.unflatten(out_treedef, rebuilt_out)
 
     wrapper._ht_jit_cache = cache  # introspection/testing hook
+    # donation bookkeeping for ht.analysis.check (rule SL105): which
+    # user-visible positional args this wrapper donates at dispatch
+    wrapper._ht_jit_donate_argnums = donate_user
     return wrapper

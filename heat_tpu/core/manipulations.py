@@ -127,6 +127,7 @@ def broadcast_to(x: DNDarray, shape: Tuple[int, ...]) -> DNDarray:
 def collect(arr: DNDarray, target_rank: int = 0) -> DNDarray:
     """Gather the whole array onto one device (reference: manipulations.py
     collect / dndarray.collect_)."""
+    sanitize_in(arr)
     out = arr.__copy__()
     out.collect_(target_rank)
     return out
@@ -355,6 +356,7 @@ def ravel(a: DNDarray) -> DNDarray:
 def redistribute(arr: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
     """Out-of-place redistribute (reference: manipulations.py redistribute).
     GSPMD layouts are canonical — validates and returns a copy."""
+    sanitize_in(arr)
     out = arr.__copy__()
     out.redistribute_(lshape_map=lshape_map, target_map=target_map)
     return out

@@ -383,6 +383,8 @@ def percentile(
                 "percentile: q must be statically known (host value); a "
                 "traced q would make the output shape data-dependent"
             )
+        # declared host boundary "percentile-q" (analysis/boundaries.py):
+        # the ONLY whitelisted sync in core/ — pinned by tier-1
         q_host = np.asarray(jax.device_get(q_dev), dtype=np.float64)
     else:
         q_host = np.asarray(q, dtype=np.float64)

@@ -47,10 +47,14 @@ _ITEMSIZE = {
 
 # "f32[8,960,960]" / "u32[]" result-type tokens
 _SHAPED = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
-# "  %x = <result-type> all-gather(" — result type is everything between
-# '=' and the op name (a bare shaped type or a tuple of them)
+# "  %x = <result-type> all-gather(" — the result type is everything
+# between '=' and the op token: a bare shaped type or a tuple of them.
+# Tuple types embed '=' inside /*index=N*/ comments, so the match anchors
+# on the SSA lhs at line start (optionally ROOT-prefixed) instead of
+# excluding '='.
 _COLLECTIVE_LINE = re.compile(
-    r"=\s*([^=]*?)\s*(" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?\("
+    r"^\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=\s*(.*?)\s*(" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?\(",
+    re.M,
 )
 
 
@@ -136,7 +140,7 @@ def _count_ops(text: str) -> Dict[str, int]:
 def _collective_bytes(text: str) -> Dict[str, int]:
     out = {op: 0 for op in COLLECTIVE_OPS}
     for m in _COLLECTIVE_LINE.finditer(text):
-        out[m.group(2)] += _shaped_bytes(m.group(1))
+        out[m.group(3)] += _shaped_bytes(m.group(2))
     return out
 
 
@@ -152,15 +156,24 @@ def _normalize_cost(compiled):
     return cost.get("flops"), cost.get("bytes accessed")
 
 
-def _compile(fn: Callable, args: tuple, kwargs: dict):
-    """Lower + compile ``fn`` for the example ``args`` without executing.
+def _build_traceable(fn: Callable, args: tuple, kwargs: dict):
+    """Normalize ``fn(*args, **kwargs)`` to one traceable program.
 
-    jax-level callables that already expose ``.lower`` (jax.jit /
-    shard_map programs) lower directly. Everything else — notably public
-    heat_tpu functions over DNDarrays — goes through the same
-    trace-to-one-program machinery as ``ht.jit``: DNDarray leaves feed
-    their physical arrays as traced inputs, metadata rebuilds at trace
-    time, outputs flatten back to physical leaves."""
+    Returns ``(kind, target, traced_in)``:
+
+    - ``("lower", fn, flat_jax_args)`` — ``fn`` already exposes ``.lower``
+      (jax.jit / shard_map programs) and no argument is a DNDarray: lower
+      it directly on the original arguments.
+    - ``("wrap", inner, traced_in)`` — everything else, notably public
+      heat_tpu functions over DNDarrays, goes through the same
+      trace-to-one-program machinery as ``ht.jit``: DNDarray leaves feed
+      their physical arrays as traced inputs, metadata rebuilds at trace
+      time, outputs flatten back to physical leaves. ``inner`` is a plain
+      function of ``traced_in``.
+
+    Shared by :func:`collective_counts` and the ``ht.analysis.check`` IR
+    lint, so both inspect the SAME program a user dispatch would run.
+    """
     import jax
 
     from ..core.dndarray import DNDarray
@@ -168,7 +181,7 @@ def _compile(fn: Callable, args: tuple, kwargs: dict):
 
     leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_leaf)
     if not any(isinstance(leaf, DNDarray) for leaf in leaves) and hasattr(fn, "lower"):
-        return fn.lower(*args, **kwargs).compile()
+        return "lower", fn, [leaf for leaf in leaves if isinstance(leaf, jax.Array)]
 
     is_traced = [isinstance(leaf, (DNDarray, jax.Array)) for leaf in leaves]
     metas = [
@@ -200,7 +213,17 @@ def _compile(fn: Callable, args: tuple, kwargs: dict):
         for leaf, t in zip(leaves, is_traced)
         if t
     ]
-    return jax.jit(inner).lower(*traced_in).compile()
+    return "wrap", inner, traced_in
+
+
+def _compile(fn: Callable, args: tuple, kwargs: dict):
+    """Lower + compile ``fn`` for the example ``args`` without executing."""
+    import jax
+
+    kind, target, traced_in = _build_traceable(fn, args, kwargs)
+    if kind == "lower":
+        return target.lower(*args, **kwargs).compile()
+    return jax.jit(target).lower(*traced_in).compile()
 
 
 def collective_counts(fn: Callable, *args, **kwargs) -> CollectiveReport:

@@ -11,6 +11,9 @@ metric that moved more than ``--threshold`` (default 10%) in the BAD
 direction without a ``measurement_suspect`` marker on either side, and
 emits ONE machine-readable verdict line plus ``BENCH_COMPARE.json`` —
 so a perf regression is caught at PR time instead of by the round judge.
+Rows only one side knows about never gate or crash the diff: a
+benchmark new in the current record reports as ``new_row``, one the
+baseline had but the current run dropped as ``missing_row``.
 
 Exit code is 0 unless ``--strict`` is given and an unflagged regression
 was found (CI runs report-only; a bench-carrying PR should run
@@ -100,6 +103,13 @@ def _latest_round_artifact() -> str | None:
 def compare(current: dict, baseline: dict, threshold: float) -> dict:
     cur_rows, base_rows = _rows_of(current), _rows_of(baseline)
     regressions, improvements, compared = [], [], 0
+    # rows only one side knows about never gate: a brand-new benchmark
+    # (in BENCH_DETAIL.json but not yet in any BENCH_r*.json artifact)
+    # is reported as new_row — it has no baseline to regress against —
+    # and a row the baseline had but the current run dropped is
+    # missing_row (usually a renamed bench; worth eyes, not a gate)
+    new_rows = sorted(set(cur_rows) - set(base_rows))
+    missing_rows = sorted(set(base_rows) - set(cur_rows))
     for row, base_fields in sorted(base_rows.items()):
         cur_fields = cur_rows.get(row)
         if cur_fields is None:
@@ -142,6 +152,8 @@ def compare(current: dict, baseline: dict, threshold: float) -> dict:
         "compared": compared,
         "regressions": regressions,
         "improvements": improvements,
+        "new_rows": new_rows,
+        "missing_rows": missing_rows,
     }
 
 
@@ -190,6 +202,8 @@ def main() -> int:
             f"{r['row']}.{r['field']}" for r in result.get("regressions", []) if "waived" in r
         ],
         "improved": [f"{r['row']}.{r['field']}" for r in result.get("improvements", [])],
+        "new_row": result.get("new_rows", []),
+        "missing_row": result.get("missing_rows", []),
         "baseline_file": result.get("baseline_file") or result.get("baseline"),
     }
     print(json.dumps(compact))

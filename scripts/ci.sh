@@ -22,7 +22,13 @@
 #     executed on an 8-device mesh (real dp/sp shardings);
 #  5. the bench regression gate, whenever bench artifacts exist
 #     (report-only here: BENCH_COMPARE.json + one verdict line; a
-#     bench-carrying change gates itself via --strict).
+#     bench-carrying change gates itself via --strict);
+#  6. the shardlint legs: source lint over heat_tpu/ (undeclared host
+#     syncs, bare jax.jit, unsanitized public ops) and the IR check of
+#     the __graft_entry__ training step on the 8-device CPU mesh
+#     (ht.analysis.check: implicit reshards, replicated
+#     materializations, missed donations). Warnings report only;
+#     error-severity findings fail the leg.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -35,6 +41,11 @@ HEAT_TPU_TELEMETRY=1 python -m pytest tests/test_smoke.py tests/test_observabili
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): OK')"
+
+python scripts/lint.py heat_tpu/
+
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+  python scripts/lint.py --ir-entry 8
 
 if [ -f BENCH_DETAIL.json ] && ls BENCH_r*.json >/dev/null 2>&1; then
   python scripts/bench_compare.py

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""shardlint CLI — the CI face of ``heat_tpu.analysis``.
+
+Two modes, combinable (both run when both are requested; exit status is
+the OR of their gates):
+
+Source lint (pass 2)::
+
+    python scripts/lint.py heat_tpu/            # lint the tree
+    python scripts/lint.py --json heat_tpu/     # machine-readable
+
+  Walks every ``.py`` file and enforces the repo invariants (SL2xx:
+  undeclared ``jax.device_get``, bare ``jax.jit``, unsanitized public
+  ops). Exit 1 iff an error-severity finding gates; warnings report
+  only.
+
+IR lint (pass 1) over the driver training step::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+        python scripts/lint.py --ir-entry 8
+
+  Builds the ``__graft_entry__`` data-parallel training step on an
+  N-device mesh and runs ``ht.analysis.check`` on it — the compiled
+  train step must launch only the collectives the algorithm needs.
+  Exit 1 iff an error-severity finding gates.
+
+Rule catalog: ``heat_tpu.analysis.findings.RULES`` / docs/PERF.md
+§ Static analysis. Whitelist workflow: heat_tpu/analysis/boundaries.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _print_report(report, label: str, as_json: bool) -> None:
+    if as_json:
+        print(json.dumps({"label": label, **report.as_dict()}))
+        return
+    for f in report.findings:
+        where = f"{f.path}:{f.line}: " if f.path else ""
+        print(f"{f.severity.upper():7s} {f.rule} {where}{f.message}")
+    n_err, n_warn = len(report.errors), len(report.warnings)
+    files = report.context.get("files", "")
+    scope = f"{files} file(s), " if isinstance(files, int) else (f"{files}: " if files else "")
+    print(
+        f"[{label}] {scope}"
+        f"{n_err} error(s), {n_warn} warning(s) "
+        f"-> {'GATE' if n_err else 'ok'}"
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("paths", nargs="*", help="files/dirs to source-lint (pass 2)")
+    ap.add_argument(
+        "--ir-entry",
+        type=int,
+        metavar="N",
+        default=None,
+        help="run ht.analysis.check over the __graft_entry__ training step "
+        "on an N-device mesh (pass 1)",
+    )
+    ap.add_argument("--json", action="store_true", help="one JSON line per pass")
+    args = ap.parse_args()
+    if not args.paths and args.ir_entry is None:
+        args.paths = [os.path.join(ROOT, "heat_tpu")]
+
+    gate = False
+    if args.paths:
+        from heat_tpu.analysis import srclint
+
+        report = srclint.lint_paths(args.paths, root=ROOT)
+        _print_report(report, "srclint", args.json)
+        gate |= not report.ok
+
+    if args.ir_entry is not None:
+        import __graft_entry__ as graft
+
+        import heat_tpu as ht
+
+        fn, example_args = graft.training_step_program(args.ir_entry)
+        report = ht.analysis.check(fn, *example_args)
+        report.context["files"] = "training_step"
+        _print_report(report, f"ircheck@{args.ir_entry}dev", args.json)
+        gate |= not report.ok
+
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+"""Deliberately-bad programs for the ``ht.analysis`` golden-finding
+tests. Each function violates one or more shardlint IR rules ON PURPOSE
+— tier-1 asserts ``ht.analysis.check`` reports them (and that the
+shipped TSQR/hSVD/ring-attention programs stay clean). Keep the
+violations obvious and commented; these are the analyzer's oracle.
+"""
+
+import jax
+
+import heat_tpu as ht
+
+
+def bad_program(x, debug=False):
+    """Three violations in one program:
+
+    - SL101: ``resplit(1)`` relayouts the full operand through an
+      all-to-all nothing in the math required (the result is consumed at
+      split=1, so XLA cannot elide the exchange);
+    - SL102: ``resplit(None)`` materializes a replicated copy of the
+      whole array (an all-gather of every byte);
+    - SL105: the replicated output has the same aval as the argument but
+      the buffer is not donated;
+    - SL106: the debug arm reads the device value on the host — never
+      taken at trace time, only the source scan can see it.
+    """
+    y = ht.exp(x.resplit(1))
+    z = x.resplit(None)
+    if debug:
+        host = jax.device_get(z._phys)  # shardlint: ignore[SL201] -- fixture
+        print(float(host.sum()))
+    return y, z
+
+
+def widening_program(x):
+    """SL104: promotes the f32 operand to f64 mid-program (an accidental
+    64-bit astype — no input justifies the widening)."""
+    return ht.sum(x.astype(ht.float64) * 2.0)
+
+
+def gather_reduce_program(x):
+    """SL103 (and SL102): gathers the whole operand replicated, then
+    reduces it — the textbook case where reduce-scatter (or a local
+    reduce + tiny all-reduce, what ``ht.sum`` on the SHARDED array
+    compiles to) moves O(1/p) of the bytes."""
+    return ht.sum(x.resplit(None))
+
+
+def donated_program(x):
+    """Clean twin of ``bad_program``'s SL105 arm: same aliasable output,
+    but the wrapper donates the argument."""
+    return ht.exp(x)

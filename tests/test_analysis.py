@@ -1,0 +1,332 @@
+"""shardlint (``heat_tpu.analysis``): golden-finding tests.
+
+The deliberately-bad fixture programs must trigger the IR rules
+(implicit reshard, replicated materialization, gather-fed reduction,
+dtype widening, missed donation, host sync); the shipped contracts —
+TSQR, hSVD level-0, ring attention, sharded reductions — must come back
+with zero error-severity findings; and the source lint must pass the
+shipped tree while catching seeded violations. This is the machine
+-enforced form of the collective pins in ``tests/test_observability.py``
+and the MULTICHIP dryrun.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+
+import analysis_fixtures as fx
+
+from heat_tpu.analysis import boundaries, findings, srclint
+
+from test_suites.basic_test import TestCase
+
+P = len(jax.devices())
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _big_split0():
+    # large enough that the per-device all-to-all shard clears the 1 MiB
+    # default threshold on the 5- and 8-device CI meshes, and divisible
+    # by both mesh sizes (2^16 * 5 rows) so no pad rows blur the
+    # aval-alias match or sit between the gather and its reduce consumer
+    return ht.random.randn(327680, 16, split=0)
+
+
+class TestIRCheckBadFixture(TestCase):
+    """The acceptance contract: one deliberately-bad program, >= 3
+    distinct rule ids (implicit reshard, missed donation, host sync)."""
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_bad_program_reports_the_golden_rules(self):
+        rep = ht.analysis.check(fx.bad_program, _big_split0())
+        self.assertFalse(rep.ok)
+        ids = set(rep.rule_ids)
+        self.assertIn("SL101", ids)  # implicit reshard (all-to-all)
+        self.assertIn("SL102", ids)  # replicated materialization
+        self.assertIn("SL105", ids)  # missed donation
+        self.assertIn("SL106", ids)  # host sync (untaken debug arm)
+        self.assertGreaterEqual(len(ids), 3)
+        # findings carry byte estimates and severities
+        gather = rep.by_rule("SL102")[0]
+        self.assertEqual(gather.severity, "error")
+        self.assertGreaterEqual(gather.nbytes, (1 << 18) * 16 * 4)
+        self.assertTrue(all(f.rule in findings.RULES for f in rep))
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_gather_fed_reduction(self):
+        rep = ht.analysis.check(fx.gather_reduce_program, _big_split0())
+        ids = set(rep.rule_ids)
+        self.assertIn("SL102", ids)
+        self.assertIn("SL103", ids)
+        # the sharded twin is the fix — and it is clean
+        clean = ht.analysis.check(lambda v: ht.sum(v), _big_split0())
+        self.assertEqual(clean.rule_ids, [])
+
+    def test_dtype_widening(self):
+        rep = ht.analysis.check(fx.widening_program, ht.random.randn(4096, split=0))
+        self.assertEqual(rep.rule_ids, ["SL104"])
+        self.assertTrue(rep.ok)  # warning severity: reports, does not gate
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_donation_bookkeeping_suppresses_sl105(self):
+        x = _big_split0()
+        undonated = ht.analysis.check(ht.jit(fx.donated_program), x)
+        self.assertIn("SL105", undonated.rule_ids)
+        donated = ht.analysis.check(ht.jit(fx.donated_program, donate_argnums=0), x)
+        self.assertNotIn("SL105", donated.rule_ids)
+
+    def test_trace_abort_reports_host_sync_not_raise(self):
+        def syncing(v):
+            s = ht.sum(v)
+            return v * float(s)  # concretizes under trace
+
+        rep = ht.analysis.check(syncing, ht.arange(64, split=0).astype(ht.float32))
+        self.assertIn("SL106", rep.rule_ids)
+        self.assertFalse(rep.ok)
+
+    def test_report_dict_shape(self):
+        rep = ht.analysis.check(fx.widening_program, ht.random.randn(256, split=0))
+        d = rep.as_dict()
+        for key in ("ok", "rule_ids", "findings", "context"):
+            self.assertIn(key, d)
+        self.assertEqual(d["findings"][0]["rule"], "SL104")
+        json.dumps(d)  # JSON-ready
+        self.assertTrue(repr(rep).startswith("AnalysisReport("))
+
+
+class TestIRCheckCleanContracts(TestCase):
+    """TSQR / hSVD level-0 / ring attention — the pinned collective
+    contracts — must report ZERO error-severity findings: the analyzer
+    turns the hand-written pins into a machine-enforced contract."""
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_tsqr_clean(self):
+        a = ht.random.randn(16 * P, 2 * P, split=0)
+        rep = ht.analysis.check(lambda x: ht.linalg.qr(x), a)
+        self.assertEqual(rep.errors, [])
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_hsvd_level0_clean(self):
+        from heat_tpu.core.linalg.svdtools import _local_svd_fn
+
+        comm = ht.get_comm()
+        phys = comm.shard(jnp.ones((16, 4 * P), jnp.float32), 1)
+        fn = _local_svd_fn(comm.mesh, comm.axis_name, 16, phys.shape[1] // P, 3, "float32", 5)
+        rep = ht.analysis.check(fn, phys)  # .lower fast path
+        self.assertEqual(rep.errors, [])
+        self.assertEqual(rep.context["collective_counts"], {})
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_ring_attention_clean(self):
+        S, D = 8 * P, 8
+        q = ht.random.randn(2, S, D, split=1)
+        rep = ht.analysis.check(
+            lambda a, b, c: ht.nn.ring_attention(a, b, c, causal=True), q, q, q
+        )
+        self.assertEqual(rep.errors, [])
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_training_step_clean(self):
+        import __graft_entry__ as graft
+
+        fn, args = graft.training_step_program(P)
+        rep = ht.analysis.check(fn, *args)
+        self.assertEqual(rep.errors, [])
+
+
+class TestSrcLint(TestCase):
+    def test_shipped_tree_is_clean(self):
+        rep = srclint.lint_paths([os.path.join(ROOT, "heat_tpu")], root=ROOT)
+        self.assertEqual([str(f) for f in rep.errors], [])
+
+    def test_seeded_bare_jit_fails(self):
+        src = textwrap.dedent(
+            """
+            import jax
+
+            def public_op(x):
+                return jax.jit(lambda v: v * 2)(x)
+            """
+        )
+        found = srclint.lint_source(src, "core/somemodule.py")
+        self.assertEqual([f.rule for f in found], ["SL202"])
+        self.assertEqual(found[0].severity, "error")
+
+    def test_seeded_undeclared_device_get_fails(self):
+        src = textwrap.dedent(
+            """
+            import jax
+
+            def mean_to_host(x):
+                return float(jax.device_get(x).mean())
+            """
+        )
+        found = srclint.lint_source(src, "core/somemodule.py")
+        self.assertEqual([f.rule for f in found], ["SL201"])
+
+    def test_new_sync_in_core_statistics_must_be_declared(self):
+        # the percentile-q declaration covers percentile ONLY: the same
+        # call in any other function of the same file still gates
+        src = "import jax\ndef median_fast(x):\n    return jax.device_get(x)\n"
+        found = srclint.lint_source(src, "heat_tpu/core/statistics.py")
+        self.assertIn("SL201", [f.rule for f in found])
+        declared = "import jax\ndef percentile(x):\n    return jax.device_get(x)\n"
+        found = srclint.lint_source(declared, "heat_tpu/core/statistics.py")
+        self.assertNotIn("SL201", [f.rule for f in found])
+
+    def test_pragma_suppresses_with_reason(self):
+        src = (
+            "import jax\n"
+            "def f(x):\n"
+            "    return jax.device_get(x)  # shardlint: ignore[SL201] -- test\n"
+        )
+        self.assertEqual(srclint.lint_source(src, "core/m.py"), [])
+
+    def test_from_jax_import_jit_flagged(self):
+        found = srclint.lint_source("from jax import jit\n", "core/m.py")
+        self.assertEqual([f.rule for f in found], ["SL202"])
+
+    def test_private_builder_jit_allowed(self):
+        src = "import jax\ndef _my_program(shape):\n    return jax.jit(lambda v: v)\n"
+        self.assertEqual(srclint.lint_source(src, "core/m.py"), [])
+
+    def test_unsanitized_public_op_warns(self):
+        src = "def frobnicate(x):\n    return x + 1\n"
+        found = srclint.lint_source(src, "heat_tpu/core/arithmetics.py")
+        self.assertEqual([f.rule for f in found], ["SL203"])
+        self.assertEqual(found[0].severity, "warning")
+        routed = "from .sanitation import sanitize_in\ndef frobnicate(x):\n    sanitize_in(x)\n    return x + 1\n"
+        self.assertEqual(srclint.lint_source(routed, "heat_tpu/core/arithmetics.py"), [])
+
+
+class TestLintCLI(TestCase):
+    """scripts/lint.py: exit 0 on the shipped tree, nonzero on a seeded
+    violation — the exact contract ci.sh leans on."""
+
+    def test_cli_exit_codes(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        ok = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "lint.py"),
+             os.path.join(ROOT, "heat_tpu")],
+            capture_output=True, text=True, env=env,
+        )
+        self.assertEqual(ok.returncode, 0, ok.stdout + ok.stderr)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            bad = os.path.join(td, "seeded.py")
+            with open(bad, "w") as f:
+                f.write("import jax\ndef op(x):\n    return jax.jit(lambda v: v)(jax.device_get(x))\n")
+            r = subprocess.run(
+                [sys.executable, os.path.join(ROOT, "scripts", "lint.py"), bad],
+                capture_output=True, text=True, env=env,
+            )
+            self.assertEqual(r.returncode, 1, r.stdout + r.stderr)
+            self.assertIn("SL201", r.stdout)
+            self.assertIn("SL202", r.stdout)
+
+
+class TestBoundaries(TestCase):
+    def test_percentile_is_the_only_core_whitelisted_sync(self):
+        """The named host-boundary whitelist holds exactly ONE core/
+        entry: the percentile q round-trip. Any new sync in a core
+        compute path must add a named declaration here — this test is
+        the tripwire that makes the diff visible."""
+        core_entries = [
+            name
+            for name, (path, _qn, _reason) in boundaries.HOST_BOUNDARIES.items()
+            if path.startswith("core/")
+        ]
+        self.assertEqual(core_entries, ["percentile-q"])
+        # and the declaration matches the real site
+        path, qualname, reason = boundaries.HOST_BOUNDARIES["percentile-q"]
+        self.assertEqual((path, qualname), ("core/statistics.py", "percentile"))
+        self.assertTrue(reason)
+
+    def test_is_declared_sync_categories(self):
+        ok, cat = boundaries.is_declared_sync("heat_tpu/core/statistics.py", "percentile")
+        self.assertEqual((ok, cat), (True, "percentile-q"))
+        ok, cat = boundaries.is_declared_sync("heat_tpu/core/io.py", "anything")
+        self.assertTrue(ok)
+        self.assertTrue(cat.startswith("host-module:"))
+        ok, cat = boundaries.is_declared_sync(
+            "heat_tpu/core/linalg/svdtools.py", "_hsvd_impl.inner_helper"
+        )
+        self.assertTrue(ok)  # a boundary owns its nested helpers
+        self.assertTrue(cat.startswith("data-dependent:"))
+        ok, _ = boundaries.is_declared_sync("heat_tpu/core/statistics.py", "median")
+        self.assertFalse(ok)
+
+    def test_every_declaration_points_at_real_code(self):
+        """Declarations must not go stale: each declared (file, function)
+        still exists in the tree."""
+        import ast
+
+        decls = (
+            [(p, q) for (p, q) in boundaries.HOST_FUNCS]
+            + [(p, q) for (p, q) in boundaries.DATA_DEPENDENT_BOUNDARIES]
+            + [(p, q) for (p, q, _r) in boundaries.HOST_BOUNDARIES.values()]
+        )
+        for path, qualname in decls:
+            full = os.path.join(ROOT, "heat_tpu", path)
+            self.assertTrue(os.path.exists(full), f"stale declaration path: {path}")
+            tree = ast.parse(open(full).read())
+            names = set()
+
+            def collect(node, stack):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                        names.add(".".join(stack + [child.name]))
+                        collect(child, stack + [child.name])
+                    else:
+                        collect(child, stack)
+
+            collect(tree, [])
+            self.assertIn(qualname, names, f"stale declaration: {path}:{qualname}")
+        for mod in boundaries.HOST_MODULES:
+            self.assertTrue(os.path.exists(os.path.join(ROOT, "heat_tpu", mod)))
+
+
+class TestBenchCompareNewRows(TestCase):
+    def _mod(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_compare", os.path.join(ROOT, "scripts", "bench_compare.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_new_and_missing_rows_never_gate(self):
+        bc = self._mod()
+        current = {"detail": {"old": {"gbps": 10.0}, "brand_new": {"gbps": 5.0}}}
+        baseline = {"key_rows": {"old": {"gbps": 10.0}, "dropped": {"gbps": 3.0}}}
+        res = bc.compare(current, baseline, 0.10)
+        self.assertEqual(res["verdict"], "ok")
+        self.assertEqual(res["new_rows"], ["brand_new"])
+        self.assertEqual(res["missing_rows"], ["dropped"])
+        self.assertEqual(res["regressions"], [])
+
+    def test_regression_still_gates_alongside_new_rows(self):
+        bc = self._mod()
+        current = {"detail": {"old": {"gbps": 5.0}, "brand_new": {"gbps": 5.0}}}
+        baseline = {"key_rows": {"old": {"gbps": 10.0}}}
+        res = bc.compare(current, baseline, 0.10)
+        self.assertEqual(res["verdict"], "regressed")
+        self.assertEqual(res["new_rows"], ["brand_new"])
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -260,6 +260,47 @@ class TestCollectiveCounts(TelemetryCase):
         with self.assertRaises(AttributeError):
             rep.not_a_collective
 
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_resplit_0_to_1_single_alltoall(self):
+        """split->split relayout is ONE all-to-all moving exactly the
+        local shard (logical bytes / p per device) — the reshard-bytes
+        floor any repartition rework must hold."""
+        x = ht.random.randn(320 * P, 2 * P, split=0)
+        rep = ht.observability.collective_counts(lambda v: v.resplit(1), x)
+        self.assertEqual(rep.counts["all-to-all"], 1)
+        self.assertEqual(rep.total, 1)
+        logical = 320 * P * 2 * P * 4
+        self.assertEqual(rep.bytes_by_op["all-to-all"] * P, logical)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_resplit_to_replicated_single_allgather(self):
+        """split->None consumed downstream is ONE all-gather of the full
+        logical array (a bare resplit(None) program gets its constraint
+        elided by XLA — the consumer keeps it honest)."""
+        x = ht.random.randn(320 * P, 2 * P, split=0)
+        rep = ht.observability.collective_counts(lambda v: ht.exp(v.resplit(None)), x)
+        self.assertEqual(rep.counts["all-gather"], 1)
+        self.assertEqual(rep.total, 1)
+        self.assertEqual(rep.bytes_by_op["all-gather"], 320 * P * 2 * P * 4)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_reshape_split1_collective_baseline(self):
+        """ROADMAP `reshape` baseline (alongside its hbm_frac row): the
+        split=1 repartition TODAY compiles to ONE all-gather of the FULL
+        operand — p x the 2*bytes bound a layout-aware repartition
+        (tile-transposing copy / minor-dim packing) should approach.
+        When that lands, this pin flips to an all-to-all and the gather
+        count drops to zero; update both assertions deliberately."""
+        x = ht.random.randn(1 << 14, 40, split=1)  # 40 lanes: 8- and 5-mesh divisible
+        rep = ht.observability.collective_counts(
+            lambda v: ht.reshape(v, (1 << 13, 80), new_split=1), x
+        )
+        self.assertEqual(rep.counts["all-gather"], 1)
+        self.assertEqual(rep.counts["all-to-all"], 0)
+        self.assertEqual(rep.total, 1)
+        # the gather assembles every logical byte on every device
+        self.assertEqual(rep.bytes_by_op["all-gather"], (1 << 14) * 40 * 4)
+
     def test_compile_only_no_execution(self):
         # inspection must not execute the program: an fn with a host-side
         # side effect traced once is acceptable, but device buffers of the
