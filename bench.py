@@ -701,16 +701,48 @@ def measure_heat_tpu() -> dict:
         eager[name] = _eager_wallclock(_scaler_eager(maker, inv))
     del Xp
 
-    # reshape there-and-back per step = 2 ops; slope halved
+    # ------------------------------------------------------------------ #
+    # redistribution-planner rows (ROADMAP `reshape`): the 1 GB planner- #
+    # routed relayouts, measured as there-and-back pairs (halved) with   #
+    # the bytes-based floor/retry machinery — a slope under one read +   #
+    # one write of the per-chip shard at HBM peak is tunnel weather.     #
+    # ------------------------------------------------------------------ #
+    redist_bytes = RESHAPE_SHAPE[0] * RESHAPE_SHAPE[1] * 4  # 1 GB operand
+    redist_floor = 2 * redist_bytes / max(len(jax.devices()), 1) / V5E_HBM_BPS
+
+    # reshape there-and-back per step = 2 ops; slope halved. ONE
+    # measurement carries both the historical `reshape` row and the
+    # ROADMAP-named `reshape_split1_1gb` row — identical workload
+    # ((1000, 250k) <-> (10M, 25) at split=1, planner-routed split-0
+    # pivot instead of the old full all-gather), now floor/retried so
+    # the hbm_frac claim survives the tunnel
     r = ht.zeros(RESHAPE_SHAPE, split=1)
-    out["reshape"] = _chained_slope(
-        r,
-        lambda y: ht.reshape(ht.reshape(y, (10_000_000, -1), new_split=1),
-                             RESHAPE_SHAPE, new_split=1),
-        sync, k1=2, k2=10,
-    ) / 2
+    out["reshape"] = _measure_bounded(
+        lambda: _chained_slope(
+            r,
+            lambda y: ht.reshape(ht.reshape(y, (10_000_000, -1), new_split=1),
+                                 RESHAPE_SHAPE, new_split=1),
+            sync, k1=2, k2=10,
+        ) / 2,
+        redist_floor,
+    )
+    _progress("reshape", out["reshape"])
     method["reshape"] = "chained-slope (pair, halved)"
+    out["reshape_split1_1gb"] = out["reshape"]
+    method["reshape_split1_1gb"] = "chained-slope (pair, halved; shared measurement with `reshape`)"
     del r
+
+    # resplit_1gb: split 0 -> 1 -> 0, one planned all-to-all per direction
+    rsp = ht.zeros(RESHAPE_SHAPE, split=0)
+    out["resplit_1gb"] = _measure_bounded(
+        lambda: _chained_slope(
+            rsp, lambda y: y.resplit(1).resplit(0), sync, k1=2, k2=10
+        ) / 2,
+        redist_floor,
+    )
+    _progress("resplit_1gb", out["resplit_1gb"])
+    method["resplit_1gb"] = "chained-slope (pair, halved)"
+    del rsp
 
     # concatenate + a dependency slice per step = concat op + cheap slice
     arrs = [ht.zeros((1000, s), split=(None if i == 1 else 1)) for i, s in enumerate(CONCAT_SIZES)]
@@ -1088,6 +1120,14 @@ def main() -> None:
     rs_bytes = 2 * RESHAPE_SHAPE[0] * RESHAPE_SHAPE[1] * 4
     detail["reshape"]["bytes_moved"] = rs_bytes
     hbm("reshape", rs_bytes)
+
+    # redistribution-planner rows: same 2x-logical read+write accounting
+    # as `reshape` (every byte of the 1 GB operand is read once and
+    # written once by the planned schedule's copies)
+    for k in ("resplit_1gb", "reshape_split1_1gb"):
+        if k in detail:
+            detail[k]["bytes_moved"] = rs_bytes
+            hbm(k, rs_bytes)
 
     # chip rows
     mfu("matmul_bf16_8k", 2 * MM_8K**3)

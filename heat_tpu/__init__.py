@@ -44,6 +44,7 @@ from . import nn
 from . import observability
 from . import optim
 from . import preprocessing
+from . import redistribution
 from . import regression
 from . import sparse
 from . import spatial

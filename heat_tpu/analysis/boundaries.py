@@ -30,14 +30,18 @@ edits to a file do not invalidate it.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+import re
+
+from typing import Dict, Optional, Tuple
 
 __all__ = [
     "HOST_MODULES",
     "HOST_FUNCS",
     "DATA_DEPENDENT_BOUNDARIES",
     "HOST_BOUNDARIES",
+    "PLANNER_MODULES",
     "is_declared_sync",
+    "planned_reshard_plan_id",
 ]
 
 # modules that are host I/O by contract (posix path suffixes)
@@ -99,6 +103,34 @@ HOST_BOUNDARIES: Dict[str, Tuple[str, str, str]] = {
         "TypeError before this read",
     ),
 }
+
+
+# ---------------------------------------------------------------------- #
+# planner-issued reshards (rules SL101/SL102)                             #
+# ---------------------------------------------------------------------- #
+# Modules whose WHOLE PURPOSE is to launch resharding collectives: the
+# redistribution executor compiles the planner's schedules, so its
+# all-to-alls/all-gathers are the budgeted, cost-modeled movement itself,
+# not an accident of operand layout. The IR lint must not flag the
+# subsystem's own programs as implicit reshards — it reports them at
+# info severity with the plan id attached instead.
+PLANNER_MODULES: Tuple[str, ...] = ("redistribution/executor.py",)
+
+# every executor program runs under jax.named_scope("redist_plan_<id>"),
+# so the plan id lands in the HLO op_name metadata of each collective it
+# launches — the marker the IR lint keys on (12 hex chars: the
+# Schedule.plan_id sha1 prefix)
+_PLAN_MARKER = re.compile(r"redist_plan_([0-9a-f]{12})")
+
+
+def planned_reshard_plan_id(hlo_line: str) -> Optional[str]:
+    """The redistribution plan id stamped on an HLO instruction line, or
+    ``None`` when the collective is not planner-issued. ``ircheck`` uses
+    this to downgrade SL101/SL102 findings on planner programs to info
+    severity (with the plan attached) instead of flagging the
+    subsystem's own schedules."""
+    m = _PLAN_MARKER.search(hlo_line)
+    return m.group(1) if m else None
 
 
 def _norm(path: str) -> str:

@@ -229,6 +229,8 @@ def check(
     context["collective_counts"] = {k: v for k, v in _count_ops(text).items() if v}
 
     # ---- SL101 / SL102: large resharding collectives -------------------
+    from .boundaries import planned_reshard_plan_id
+
     gather_names: List[Tuple[str, int]] = []
     for m in _COLLECTIVE_LINE.finditer(text):
         ssa, result_type, op = m.group(1), m.group(2), m.group(3)
@@ -238,6 +240,29 @@ def check(
         if op not in ("all-to-all", "all-gather") or nbytes < min_bytes:
             continue
         rule = "SL101" if op == "all-to-all" else "SL102"
+        # planner-issued reshards (redistribution/executor.py programs run
+        # under jax.named_scope("redist_plan_<id>"), stamping the plan id
+        # into the instruction's op_name metadata) are the budgeted,
+        # cost-modeled movement itself — report them at info severity with
+        # the plan attached instead of flagging the subsystem's own
+        # schedules (see boundaries.PLANNER_MODULES)
+        line_end = text.find("\n", m.end())
+        full_line = text[m.start() : len(text) if line_end == -1 else line_end]
+        plan_id = planned_reshard_plan_id(full_line)
+        if plan_id is not None:
+            findings.append(
+                Finding(
+                    rule,
+                    "info",
+                    f"planned reshard (redist plan {plan_id}): {op} moves "
+                    f"~{nbytes} B ({ssa}) under the redistribution "
+                    "planner's peak-memory budget — inspect with "
+                    "ht.redistribution.explain",
+                    op=op,
+                    nbytes=nbytes,
+                )
+            )
+            continue
         severity = "error" if nbytes >= err_bytes else "warning"
         what = (
             "implicit reshard: an all-to-all relayouts"

@@ -300,11 +300,14 @@ class MeshCommunication(Communication):
     def reshard_phys(
         self, phys: jax.Array, gshape, old_split: Optional[int], new_split: Optional[int]
     ) -> jax.Array:
-        """Move a physical array from one split layout to another:
-        unpad → repad along the new axis → device_put (the whole of the
-        reference's split→split Isend/Irecv tiling, dndarray.py:1406)."""
-        from . import _padding
-
+        """Move a physical array from one split layout to another (the
+        whole of the reference's split→split Isend/Irecv tiling,
+        dndarray.py:1406). Routed through the redistribution planner
+        (``heat_tpu.redistribution``): the movement is normalized to a
+        :class:`~heat_tpu.redistribution.spec.RedistSpec`, planned under
+        the peak-memory budget, and executed as the planned collective
+        schedule (``HEAT_TPU_REDIST_PLANNER=0`` restores the legacy
+        single device_put)."""
         if _telemetry._ENABLED:
             # the moved volume is the LOGICAL payload (every byte crosses
             # the mesh on a split change; pad rows are manufactured)
@@ -319,8 +322,9 @@ class MeshCommunication(Communication):
                 bytes_moved=moved,
                 traced=isinstance(phys, jax.core.Tracer),
             )
-        logical = _padding.unpad(phys, tuple(gshape), old_split)
-        return self.shard(logical, new_split)
+        from ..redistribution import executor as _redist_exec
+
+        return _redist_exec.resplit_phys(self, phys, gshape, old_split, new_split)
 
     # ------------------------------------------------------------------ #
     # communicator management                                            #

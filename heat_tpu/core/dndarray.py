@@ -540,7 +540,12 @@ class DNDarray:
     def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
         """In-place redistribution along a new split axis (reference
         dndarray.py:1406: Allgatherv / slice / tiled Isend-Irecv chains).
-        Here: one resharding device_put — XLA chooses the collective."""
+        Routed through the redistribution planner
+        (``ht.redistribution``): the move executes as a cost-modeled
+        collective schedule — direct/chunked all-to-all, ppermute ring,
+        or the explicit replicate all-gather — under the configured
+        peak-memory budget. ``ht.redistribution.explain(self, axis)``
+        shows the plan this call will run."""
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
@@ -556,7 +561,9 @@ class DNDarray:
         return self
 
     def resplit(self, axis: Optional[int] = None) -> "DNDarray":
-        """Out-of-place resplit (reference manipulations.py:3479)."""
+        """Out-of-place resplit (reference manipulations.py:3479).
+        Planner-routed like :meth:`resplit_`; see
+        ``ht.redistribution.explain``."""
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return DNDarray(

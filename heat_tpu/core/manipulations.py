@@ -382,11 +382,11 @@ def repeat(a, repeats, axis: Optional[int] = None) -> DNDarray:
 
 @_functools.lru_cache(maxsize=1024)
 def _reshape_program(comm, in_gshape, in_split, out_shape, out_split):
-    """One compiled program for reshape-with-repartition: unpad slice →
-    reshape → output pad, with the output sharding pinned — XLA fuses the
-    copies and emits the all-to-all (the reference's Alltoallv,
-    manipulations.py:1994) in the same program. The eager formulation paid
-    separate unpad/reshape/pad/device_put passes."""
+    """LEGACY reshape-with-repartition program (one monolithic
+    unpad → reshape → pad with the output sharding pinned — XLA chose
+    the collective, a full all-gather for the split-1 case). Kept as the
+    ``HEAT_TPU_REDIST_PLANNER=0`` escape hatch; the live path plans a
+    bounded-footprint schedule via ``heat_tpu.redistribution``."""
     from . import _padding
 
     def fn(phys):
@@ -397,11 +397,11 @@ def _reshape_program(comm, in_gshape, in_split, out_shape, out_split):
     return comm.jit_sharded(fn, len(out_shape), out_split)
 
 
-def reshape(a: DNDarray, *shape, **kwargs) -> DNDarray:
-    """Reshape without changing data (reference: manipulations.py:1994 —
-    Alltoallv repartition with ``new_split`` kw; one jitted
-    reshape+repartition program, the all-to-all emitted by XLA)."""
-    sanitize_in(a)
+def _normalize_reshape_args(a, shape, new_split):
+    """Shared shape/-1/``new_split`` resolution for :func:`reshape` AND
+    ``ht.redistribution.explain(reshape=...)`` — ONE resolver, so the
+    plan ``explain`` shows is built from exactly the (shape, new_split)
+    the public call executes."""
     if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
         shape = tuple(shape[0])
     shape = list(shape)
@@ -417,16 +417,23 @@ def reshape(a: DNDarray, *shape, **kwargs) -> DNDarray:
     shape = sanitize_shape(tuple(shape))
     if int(np.prod(shape)) != a.size:
         raise ValueError(f"cannot reshape array of size {a.size} into shape {tuple(shape)}")
-
-    new_split = kwargs.pop("new_split", None)
-    if kwargs:
-        raise TypeError(f"reshape got unexpected keyword arguments {list(kwargs)}")
     if new_split is None:
         new_split = a.split
         if new_split is not None and new_split >= len(shape):
             # fewer output dims than the old split axis: clamp to the last
             new_split = len(shape) - 1
-    new_split = sanitize_axis(shape, new_split)
+    return shape, sanitize_axis(shape, new_split)
+
+
+def reshape(a: DNDarray, *shape, **kwargs) -> DNDarray:
+    """Reshape without changing data (reference: manipulations.py:1994 —
+    Alltoallv repartition with ``new_split`` kw; one jitted
+    reshape+repartition program, the all-to-all emitted by XLA)."""
+    sanitize_in(a)
+    new_split = kwargs.pop("new_split", None)
+    if kwargs:
+        raise TypeError(f"reshape got unexpected keyword arguments {list(kwargs)}")
+    shape, new_split = _normalize_reshape_args(a, shape, new_split)
     if a._is_planar:
         from . import complex_planar as _cp
 
@@ -434,8 +441,19 @@ def reshape(a: DNDarray, *shape, **kwargs) -> DNDarray:
     if new_split is not None and len(shape) > 0 and a.ndim > 0 and a.size != 0:
         # zero-SIZE arrays take the eager path: XLA stores them replicated,
         # which a pinned out_sharding cannot express
-        prog = _reshape_program(a.comm, a.gshape, a.split, tuple(shape), new_split)
-        phys = prog(a._phys)
+        from .. import redistribution as _redist
+
+        if _redist.planner_enabled():
+            # planner-routed repartition (cost-modeled schedule: split-0
+            # pivot / chunked all-to-all instead of the monolithic
+            # gather); ht.redistribution.explain(a, reshape=shape,
+            # new_split=...) shows the chosen plan
+            phys = _redist.reshape_phys(
+                a.comm, a._phys, a.gshape, a.split, tuple(shape), new_split
+            )
+        else:
+            prog = _reshape_program(a.comm, a.gshape, a.split, tuple(shape), new_split)
+            phys = prog(a._phys)
         return DNDarray(phys, tuple(shape), a.dtype, new_split, a.device, a.comm)
     result = jnp.reshape(a.larray, shape)
     return _wrap(result, new_split, a, dtype=a.dtype)

@@ -1,0 +1,58 @@
+"""Cost-modeled redistribution planning (``ht.redistribution``).
+
+The reference treats resplit as a first-class algorithm
+(Allgatherv / tiled Isend-Irecv chains chosen per case,
+heat dndarray.py:1406); the seed of this repo collapsed every relayout
+into one implicit GSPMD collective. This subsystem restores the
+algorithmic treatment, TPU-native (arXiv:2112.01075): split changes and
+reshape repartitions are *planned* —
+
+- :mod:`~heat_tpu.redistribution.spec` — :class:`RedistSpec`, the
+  normalized problem statement and cache key;
+- :mod:`~heat_tpu.redistribution.planner` — the byte/step/peak-memory
+  cost model choosing among direct all-to-all, budget-chunked all-to-all
+  pipelines, the ppermute ring, the split-0-pivot (minor-dim packing)
+  reshape, and the explicit full-all-gather replicate;
+- :mod:`~heat_tpu.redistribution.schedule` — the inspectable,
+  golden-testable schedule IR with per-step peak-memory accounting;
+- :mod:`~heat_tpu.redistribution.executor` — lowers schedules to jitted
+  ``shard_map`` programs (per-spec program cache); the compiled HLO's
+  collective census must equal the plan's, and tier-1 pins it.
+
+``ht.redistribution.explain(arr, axis)`` (or ``reshape=...``) returns
+the plan the public ``resplit``/``reshape`` APIs will execute. The
+peak-memory budget is the ``HEAT_TPU_REDIST_BUDGET_MB`` env knob;
+``HEAT_TPU_REDIST_PLANNER=0`` restores the legacy one-collective paths.
+"""
+
+from . import executor
+from . import planner
+from . import schedule as schedule_ir
+from . import spec as spec_mod
+
+from .executor import execute, reshape_phys, resplit_phys
+from .planner import (
+    budget_bytes,
+    clear_plan_cache,
+    explain,
+    golden_specs,
+    plan,
+    planner_enabled,
+)
+from .schedule import Schedule, Step
+from .spec import RedistSpec
+
+__all__ = [
+    "RedistSpec",
+    "Schedule",
+    "Step",
+    "budget_bytes",
+    "clear_plan_cache",
+    "execute",
+    "explain",
+    "golden_specs",
+    "plan",
+    "planner_enabled",
+    "reshape_phys",
+    "resplit_phys",
+]

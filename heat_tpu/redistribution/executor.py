@@ -1,0 +1,383 @@
+"""Schedule execution — lowering plans to jitted ``shard_map`` programs.
+
+The planner's :class:`~heat_tpu.redistribution.schedule.Schedule` is the
+contract; this module compiles it to exactly the collectives it lists
+(tier-1 pins ``ht.observability.collective_counts`` == the plan's census
+for the golden specs). One program per ``(comm, spec, budget)``, cached
+and registered with ``communication.register_mesh_cache`` so world
+rebuilds drop programs baked onto a defunct mesh.
+
+Every program body runs under ``jax.named_scope("redist_plan_<id>")``:
+the plan id lands in the HLO ``op_name`` metadata of every collective
+the program launches, which is how shardlint (``analysis/ircheck``)
+recognizes planner-issued reshards and reports them at info severity
+with the plan attached instead of flagging the subsystem's own programs
+(see ``analysis/boundaries.PLANNER_MODULES``).
+
+Padding discipline (see ``core/_padding``): programs take the physical
+(src-split-padded) array and return the physical dst-split-padded array;
+pads along the exchanged axes are added/dropped with LOCAL copies inside
+the same program, so the zero-pad invariant holds on the way out.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from typing import Optional, Tuple
+
+from ..core._jax_compat import shard_map
+from ..observability import telemetry as _telemetry
+from . import planner as _planner
+from .schedule import Schedule
+from .spec import RedistSpec
+
+__all__ = ["execute", "resplit_phys", "reshape_phys", "clear_program_cache"]
+
+
+def _pad_extent(n: int, p: int) -> int:
+    from ..core import _padding
+
+    return _padding.pad_extent(int(n), int(p))
+
+
+def _plan_scope(plan_id: str):
+    """The ``redist_plan_<id>`` named scope every program body runs
+    under — IFF this module is registered in
+    ``analysis/boundaries.PLANNER_MODULES``. The registration is the
+    live switch: deregistering the executor stops the stamping, and
+    shardlint's SL101/SL102 findings on its collectives revert from
+    info+plan_id back to warning/error severity."""
+    from ..analysis import boundaries as _boundaries
+
+    if "redistribution/executor.py" in _boundaries.PLANNER_MODULES:
+        return jax.named_scope(f"redist_plan_{plan_id}")
+    return contextlib.nullcontext()
+
+
+def _axis_spec(axis_name: str, ndim: int, split: Optional[int]) -> P:
+    if split is None:
+        return P(*(None,) * ndim)
+    return P(*(axis_name if k == split else None for k in range(ndim)))
+
+
+def _a2a_chunks(sched: Schedule) -> Tuple[int, int]:
+    """(before, after) all_to_all counts around the plan's ``reshape``
+    step — the chunk counts of the pivot's two collective groups, both
+    structural (a move plan has no reshape step: everything lands in
+    ``before``). The executor re-derives C from the schedule itself so
+    program and plan cannot disagree, and from step KINDS, not the
+    human-readable detail text."""
+    before = after = 0
+    seen_reshape = False
+    for st in sched.steps:
+        if st.kind == "reshape":
+            seen_reshape = True
+        elif st.kind == "all_to_all":
+            if seen_reshape:
+                after += 1
+            else:
+                before += 1
+    return before, after
+
+
+def _chunked_all_to_all(x, axis_name: str, p: int, split_axis: int, concat_axis: int, C: int):
+    """Tiled all-to-all in C equal chunks along the concat axis, chunk
+    results scattered (in place) into the destination-layout buffer.
+    C == 1 is the direct single-collective form."""
+    if C <= 1:
+        return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
+    x2 = jnp.moveaxis(x, concat_axis, 0)
+    s_ax = split_axis + 1 if split_axis < concat_axis else split_axis
+    Bc = x2.shape[0]
+    step = Bc // C
+    out_shape = (Bc * p,) + tuple(
+        d // p if k + 1 == s_ax else d for k, d in enumerate(x2.shape[1:])
+    )
+    out = jnp.zeros(out_shape, x.dtype)
+    for c in range(C):
+        chunk = lax.slice_in_dim(x2, c * step, (c + 1) * step, axis=0)
+        r = lax.all_to_all(chunk, axis_name, s_ax, 0, tiled=True)  # (p*step, ...)
+        for s in range(p):
+            piece = lax.slice_in_dim(r, s * step, (s + 1) * step, axis=0)
+            out = lax.dynamic_update_slice_in_dim(out, piece, s * Bc + c * step, axis=0)
+    return jnp.moveaxis(out, 0, concat_axis)
+
+
+def _ring_exchange(x, axis_name: str, p: int, split_axis: int, concat_axis: int):
+    """The same split i->j move as p-1 ppermute hops: at distance d every
+    device ships ONE neighbor block, so only 2·(local/p) bytes are in
+    flight per step — the minimal-footprint schedule."""
+    r = lax.axis_index(axis_name)
+    S = x.shape[split_axis]
+    Bs = S // p
+    Bc = x.shape[concat_axis]
+    out_shape = tuple(
+        d * p if k == concat_axis else (Bs if k == split_axis else d)
+        for k, d in enumerate(x.shape)
+    )
+    out = jnp.zeros(out_shape, x.dtype)
+    own = lax.dynamic_slice_in_dim(x, r * Bs, Bs, axis=split_axis)
+    out = lax.dynamic_update_slice_in_dim(out, own, r * Bc, axis=concat_axis)
+    for d in range(1, p):
+        blk = lax.dynamic_slice_in_dim(x, ((r + d) % p) * Bs, Bs, axis=split_axis)
+        recv = lax.ppermute(blk, axis_name, [(s, (s + d) % p) for s in range(p)])
+        out = lax.dynamic_update_slice_in_dim(out, recv, ((r - d) % p) * Bc, axis=concat_axis)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# program builders (one compiled program per (comm, spec, budget))      #
+# --------------------------------------------------------------------- #
+@functools.lru_cache(maxsize=512)
+def _move_program(comm, spec: RedistSpec, budget: int):
+    """split i -> split j (all-to-all / chunked / ring) on the physical
+    array: pad dst axis (local) -> shard_map exchange -> drop src-axis
+    pad (local)."""
+    sched = _planner.plan(spec, budget)
+    mesh, axis_name = comm.mesh, comm.axis_name
+    p = spec.mesh_size
+    i, j = spec.src_split, spec.dst_split
+    ndim = len(spec.gshape)
+    Ni, Nj = spec.gshape[i], spec.gshape[j]
+    Nip, Njp = _pad_extent(Ni, p), _pad_extent(Nj, p)
+    C = max(_a2a_chunks(sched)[0], 1)
+    ring = sched.strategy == "ring"
+
+    def body(xl):
+        if ring:
+            return _ring_exchange(xl, axis_name, p, split_axis=j, concat_axis=i)
+        return _chunked_all_to_all(xl, axis_name, p, split_axis=j, concat_axis=i, C=C)
+
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_axis_spec(axis_name, ndim, i),),
+        out_specs=_axis_spec(axis_name, ndim, j),
+        check_vma=False,
+    )
+
+    def fn(phys):
+        with _plan_scope(sched.plan_id):
+            x = phys
+            if Njp != Nj:  # local: axis j is unsharded in the src layout
+                widths = [(0, 0)] * ndim
+                widths[j] = (0, Njp - Nj)
+                x = jnp.pad(x, widths)
+            y = mapped(x)
+            if Nip != Ni:  # local: axis i is unsharded in the dst layout
+                y = lax.slice_in_dim(y, 0, Ni, axis=i)
+            return y
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=512)
+def _pivot_program(comm, spec: RedistSpec, budget: int):
+    """Reshape-with-repartition through the split-0 pivot: all-to-all to
+    the flat-contiguous split-0 layout, LOCAL row-major reshape (the
+    minor-dim packing copy runs at full width), all-to-all out."""
+    sched = _planner.plan(spec, budget)
+    mesh, axis_name = comm.mesh, comm.axis_name
+    p = spec.mesh_size
+    s, t = spec.src_split, spec.dst_split
+    in_shape, out_shape = spec.gshape, spec.out_shape
+    ndim_in, ndim_out = len(in_shape), len(out_shape)
+    n_in, n_out = _a2a_chunks(sched)
+    C1, C2 = max(n_in, 1), max(n_out, 1)
+
+    def body(xl):
+        y = xl
+        if s is not None and s != 0:
+            y = _chunked_all_to_all(y, axis_name, p, split_axis=0, concat_axis=s, C=C1)
+            in_s, in_sp = in_shape[s], _pad_extent(in_shape[s], p)
+            if in_sp != in_s:
+                y = lax.slice_in_dim(y, 0, in_s, axis=s)
+        local_rows = out_shape[0] // p
+        y = y.reshape((local_rows,) + tuple(out_shape[1:]))
+        if t is not None and t != 0:
+            out_t, out_tp = out_shape[t], _pad_extent(out_shape[t], p)
+            if out_tp != out_t:
+                widths = [(0, 0)] * ndim_out
+                widths[t] = (0, out_tp - out_t)
+                y = jnp.pad(y, widths)
+            y = _chunked_all_to_all(y, axis_name, p, split_axis=t, concat_axis=0, C=C2)
+        return y
+
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_axis_spec(axis_name, ndim_in, s),),
+        out_specs=_axis_spec(axis_name, ndim_out, t),
+        check_vma=False,
+    )
+
+    def fn(phys):
+        with _plan_scope(sched.plan_id):
+            return mapped(phys)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=512)
+def _gather_reshape_program(comm, spec: RedistSpec, budget: int):
+    """The explicit fallback: replicate the physical operand (ONE
+    all-gather), drop pads, reshape, re-pad and slice out the dst shard.
+    Also serves the replicated-source reshape (no gather: the constraint
+    on an already-replicated operand is a no-op)."""
+    from ..core import _padding
+
+    sched = _planner.plan(spec, budget)
+    mesh, axis_name = comm.mesh, comm.axis_name
+    s, t = spec.src_split, spec.dst_split
+    out_shape = spec.out_shape
+    ndim_out = max(len(out_shape), 1)
+
+    def fn(phys):
+        with _plan_scope(sched.plan_id):
+            full = lax.with_sharding_constraint(
+                phys, comm.sharding(max(phys.ndim, 1), None)
+            )
+            logical = _padding.unpad(full, spec.gshape, s)
+            r = jnp.reshape(logical, out_shape) if spec.is_reshape else logical
+            rp = _padding.pad_logical(r, t, comm.size)
+            return lax.with_sharding_constraint(rp, comm.sharding(ndim_out, t))
+
+    return comm.jit_sharded(fn, ndim_out, t)
+
+
+@functools.lru_cache(maxsize=512)
+def _local_reshape_program(comm, spec: RedistSpec, budget: int):
+    """Zero-collective reshape paths: 1-device meshes and replicated
+    sources (the dst distribution is a local slice)."""
+    from ..core import _padding
+
+    sched = _planner.plan(spec, budget)
+    s, t = spec.src_split, spec.dst_split
+    out_shape = spec.out_shape
+    ndim_out = max(len(out_shape), 1)
+
+    def fn(phys):
+        with _plan_scope(sched.plan_id):
+            logical = _padding.unpad(phys, spec.gshape, s)
+            r = jnp.reshape(logical, out_shape)
+            rp = _padding.pad_logical(r, t, comm.size)
+            return lax.with_sharding_constraint(rp, comm.sharding(ndim_out, t))
+
+    return comm.jit_sharded(fn, ndim_out, t)
+
+
+def clear_program_cache() -> None:
+    _move_program.cache_clear()
+    _pivot_program.cache_clear()
+    _gather_reshape_program.cache_clear()
+    _local_reshape_program.cache_clear()
+
+
+# a world rebuild (init_distributed) invalidates every program: the
+# mesh (and the comm identity in the cache key) baked into them is gone
+from ..core.communication import register_mesh_cache as _register_mesh_cache
+
+_register_mesh_cache(_move_program)
+_register_mesh_cache(_pivot_program)
+_register_mesh_cache(_gather_reshape_program)
+_register_mesh_cache(_local_reshape_program)
+
+
+# --------------------------------------------------------------------- #
+# execution                                                             #
+# --------------------------------------------------------------------- #
+def _reshard_direct(comm, phys, gshape, src, dst):
+    """The legacy relayout (unpad -> repad -> placement): still the
+    lowering for the no-collective strategies, where GSPMD's local
+    slice IS the schedule."""
+    from ..core import _padding
+
+    logical = _padding.unpad(phys, tuple(gshape), src)
+    return comm.shard(logical, dst)
+
+
+def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
+    """Run the planned redistribution of ``phys`` (a physical array laid
+    out per ``spec.src_split``) and return the dst-layout physical
+    array. Trace-safe: under a trace the cached jitted programs inline
+    and the eager placements lower to sharding constraints."""
+    if sched is None:
+        sched = _planner.plan(spec)
+    else:
+        # the program builders compile the PLANNER's schedule for
+        # (spec, budget) — a hand-built/modified Schedule would be
+        # silently ignored, so refuse it instead
+        planned = _planner.plan(spec, sched.budget_bytes)
+        if planned.plan_id != sched.plan_id:
+            raise ValueError(
+                f"execute: schedule {sched.plan_id} is not the planner's "
+                f"plan for {spec!r} under budget {sched.budget_bytes} B "
+                f"(expected {planned.plan_id}); executor programs compile "
+                "from the plan cache, not from caller-provided schedules"
+            )
+    if _telemetry._ENABLED:
+        _telemetry.inc("redist.execute.calls")
+    strategy = sched.strategy
+    budget = sched.budget_bytes
+    if strategy == "noop":
+        return phys
+    if strategy in ("slice",) or (strategy == "local" and not spec.is_reshape):
+        # no-collective placements: GSPMD's local slice IS the schedule,
+        # and with no collective there is nothing for shardlint to flag
+        return _reshard_direct(comm, phys, spec.gshape, spec.src_split, spec.dst_split)
+    if strategy == "replicate":
+        # the explicit full all-gather runs as a stamped program too, so
+        # its SL102 finding reports as info with the plan id attached
+        return _gather_reshape_program(comm, spec, budget)(phys)
+    if strategy in ("all-to-all", "chunked-all-to-all", "ring"):
+        return _move_program(comm, spec, budget)(phys)
+    if strategy == "split0-pivot":
+        return _pivot_program(comm, spec, budget)(phys)
+    if strategy == "gather-reshape":
+        return _gather_reshape_program(comm, spec, budget)(phys)
+    if strategy in ("local-reshape", "local"):
+        if spec.src_split == 0 and spec.dst_split == 0 and spec.mesh_size > 1:
+            # divisible split-0 <-> split-0: device blocks stay put
+            return _pivot_program(comm, spec, budget)(phys)
+        return _local_reshape_program(comm, spec, budget)(phys)
+    raise ValueError(f"unknown strategy {strategy!r} (plan {sched.plan_id})")
+
+
+def resplit_phys(comm, phys, gshape, src: Optional[int], dst: Optional[int]):
+    """Planner-routed split change of a physical array — the engine
+    under ``DNDarray.resplit``/``resplit_`` and
+    ``MeshCommunication.reshard_phys``."""
+    gshape = tuple(int(v) for v in gshape)
+    if (
+        not _planner.planner_enabled()
+        or phys.ndim != len(gshape)  # planar-complex plane pairs: legacy path
+        or any(v == 0 for v in gshape)
+    ):
+        return _reshard_direct(comm, phys, gshape, src, dst)
+    spec = RedistSpec.normalize(gshape, np.dtype(phys.dtype).name, src, dst, comm.size)
+    return execute(comm, phys, spec)
+
+
+def reshape_phys(comm, phys, in_gshape, in_split, out_shape, out_split):
+    """Planner-routed reshape-with-repartition of a physical array — the
+    engine under ``ht.reshape(..., new_split=...)``."""
+    in_gshape = tuple(int(v) for v in in_gshape)
+    out_shape = tuple(int(v) for v in out_shape)
+    spec = RedistSpec.normalize(
+        in_gshape,
+        np.dtype(phys.dtype).name,
+        in_split,
+        out_split,
+        comm.size,
+        reshape_to=out_shape,
+    )
+    return execute(comm, phys, spec)

@@ -1,0 +1,550 @@
+"""Cost-modeled redistribution planning.
+
+Every nontrivial relayout used to be ONE monolithic collective chosen
+implicitly by GSPMD (``resplit(None)`` = one full all-gather; the
+split-1 reshape repartition = one full all-gather at ~0.09x HBM).
+Following "Memory-efficient array redistribution through portable
+collective communication" (arXiv:2112.01075), the planner instead
+*decomposes* each :class:`~heat_tpu.redistribution.spec.RedistSpec`
+into a bounded-footprint :class:`~heat_tpu.redistribution.schedule.Schedule`
+chosen by an explicit cost model over candidate strategies:
+
+==================  ====================================================
+strategy            when / what
+==================  ====================================================
+``noop``            same split, same shape — nothing moves
+``local``           1-device mesh (and zero-size arrays): local copy
+``slice``           replicated → split: every device slices its shard,
+                    no collective
+``replicate``       split → replicated: the one FULL all-gather left in
+                    the system, and only as this explicit strategy
+``all-to-all``      split i → j whose send+recv transient fits the
+                    budget: one tiled all-to-all (the pinned easy case)
+``chunked-all-to-all``  the same move pipelined in C budget-sized
+                    chunks: slice → all-to-all → scatter per chunk
+``ring``            minimal-footprint fallback: p-1 ``ppermute`` hops,
+                    one neighbor block in flight per step — chosen when
+                    chunking would need more than p-1 laps
+``split0-pivot``    reshape-with-repartition via a split-0 intermediate
+                    (the minor-dim packing relayout): all-to-all in,
+                    LOCAL row-major reshape at full lane width,
+                    all-to-all out — replaces the full all-gather the
+                    split-1 reshape used to compile to
+``local-reshape``   reshape whose device blocks stay put (split-0 ↔
+                    split-0 divisible, or replicated source): 0
+                    collectives
+``gather-reshape``  fallback when divisibility rules out the pivot:
+                    gather → reshape → slice (the old behavior, now
+                    explicit and accounted)
+==================  ====================================================
+
+Cost model: a collective step costs ``ALPHA_BYTES + bytes_moved``
+(latency expressed in byte-equivalents, so step count and volume share
+one unit). Among candidates whose per-step transient peak fits the
+``HEAT_TPU_REDIST_BUDGET_MB`` budget the cheapest wins; when nothing
+fits, the smallest peak wins (ring is that floor for split moves).
+Local copy steps (pad/slice/reshape) are bounded by one shard and are
+accounted but not chunkable — the budget must be at least one
+destination shard.
+
+Plans are cached per ``(spec, budget)`` and feed the PR-1 telemetry
+registry: ``redist.plan_cache.{hit,miss}``, ``redist.planned_bytes``,
+``redist.steps``, ``redist.peak_bytes``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability import events as _obs_events
+from ..observability import telemetry as _telemetry
+from .schedule import Schedule, Step
+from .spec import RedistSpec
+
+__all__ = [
+    "ALPHA_BYTES",
+    "DEFAULT_BUDGET_MB",
+    "budget_bytes",
+    "clear_plan_cache",
+    "explain",
+    "golden_specs",
+    "plan",
+    "planner_enabled",
+]
+
+#: per-collective launch latency expressed in byte-equivalents (~1 MiB
+#: of ICI time per collective dispatch): makes step count and byte
+#: volume comparable in one scalar cost.
+ALPHA_BYTES = 1 << 20
+
+DEFAULT_BUDGET_MB = 256
+_BUDGET_ENV = "HEAT_TPU_REDIST_BUDGET_MB"
+_ENABLE_ENV = "HEAT_TPU_REDIST_PLANNER"
+
+_plan_lock = threading.Lock()
+_plan_cache: Dict[Tuple[RedistSpec, int], Schedule] = {}
+#: bounded like the executor's program caches (lru_cache(512)); planning
+#: is cheap pure Python, so FIFO eviction on overflow is plenty
+_PLAN_CACHE_MAX = 4096
+
+
+def planner_enabled() -> bool:
+    """Planner routing switch (``HEAT_TPU_REDIST_PLANNER=0`` restores
+    the legacy single-device_put relayout paths)."""
+    val = os.environ.get(_ENABLE_ENV, "1").strip().lower()
+    return val not in ("0", "false", "off", "no")
+
+
+def budget_bytes() -> int:
+    """Per-device peak-memory budget for redistribution transients
+    (``HEAT_TPU_REDIST_BUDGET_MB``, default 256 MiB)."""
+    raw = os.environ.get(_BUDGET_ENV, "")
+    try:
+        mb = int(raw) if raw.strip() else DEFAULT_BUDGET_MB
+    except ValueError:
+        mb = DEFAULT_BUDGET_MB
+    return max(1, mb) << 20
+
+
+def clear_plan_cache() -> None:
+    with _plan_lock:
+        _plan_cache.clear()
+
+
+# --------------------------------------------------------------------- #
+# geometry helpers                                                      #
+# --------------------------------------------------------------------- #
+def _prod(shape) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _pad_extent(n: int, p: int) -> int:
+    from ..core import _padding
+
+    return _padding.pad_extent(int(n), int(p))
+
+
+def _divisor_chunks(extent: int, needed: int) -> int:
+    """Smallest chunk count >= ``needed`` that divides ``extent`` (chunks
+    must be equal-sized for the scatter reassembly to be static)."""
+    extent = max(int(extent), 1)
+    needed = min(max(1, int(needed)), extent)
+    for c in range(needed, extent + 1):
+        if extent % c == 0:
+            return c
+    return extent
+
+
+def _local_move_bytes(spec: RedistSpec) -> int:
+    """Per-device bytes of the doubly-padded shard a split i->j move
+    exchanges (source split axis padded for the source layout, dest
+    split axis padded so the tiled all-to-all divides evenly)."""
+    p = spec.mesh_size
+    shape = list(spec.gshape)
+    shape[spec.src_split] = _pad_extent(shape[spec.src_split], p)
+    shape[spec.dst_split] = _pad_extent(shape[spec.dst_split], p)
+    return _prod(shape) // p * spec.itemsize
+
+
+# --------------------------------------------------------------------- #
+# candidate builders                                                    #
+# --------------------------------------------------------------------- #
+def _a2a_chunk_steps(
+    L: int, p: int, C: int, what: str, pad_step: Optional[Step], tail_slice: Optional[Step]
+) -> List[Step]:
+    """C laps of slice -> all-to-all, then a scatter reassembly (written
+    in place into the destination buffer: no transient)."""
+    steps: List[Step] = []
+    if pad_step is not None:
+        steps.append(pad_step)
+    crossing = L * (p - 1) // p  # the diagonal block stays home
+    if C <= 1:
+        steps.append(
+            Step("all_to_all", bytes_moved=crossing, peak_bytes=2 * L, detail=what)
+        )
+    else:
+        for c in range(C):
+            steps.append(
+                Step("slice", peak_bytes=L // C, detail=f"chunk {c}/{C} of {what}", chunk=c)
+            )
+            steps.append(
+                Step(
+                    "all_to_all",
+                    bytes_moved=crossing // C,
+                    peak_bytes=2 * L // C,
+                    detail=what,
+                    chunk=c,
+                )
+            )
+        steps.append(Step("pack", peak_bytes=0, detail="scatter chunks into dst shard"))
+    if tail_slice is not None:
+        steps.append(tail_slice)
+    return steps
+
+
+def _resplit_candidates(spec: RedistSpec, budget: int) -> List[Schedule]:
+    """split i -> split j candidates: (chunked) all-to-all and the ring."""
+    p = spec.mesh_size
+    i, j = spec.src_split, spec.dst_split
+    L = _local_move_bytes(spec)
+    Nj, Njp = spec.gshape[j], _pad_extent(spec.gshape[j], p)
+    Ni, Nip = spec.gshape[i], _pad_extent(spec.gshape[i], p)
+    pad_step = (
+        Step("pad", peak_bytes=L, detail=f"pad axis {j} {Nj}->{Njp} (local)")
+        if Njp != Nj
+        else None
+    )
+    tail = (
+        Step("slice", peak_bytes=L, detail=f"drop axis {i} pad {Nip}->{Ni} (local)")
+        if Nip != Ni
+        else None
+    )
+    # concat axis is the source split axis: its local extent is what the
+    # chunk laps tile over
+    concat_extent = Nip // p
+    needed = -(-2 * L // budget)
+    C = _divisor_chunks(concat_extent, needed)
+
+    what = f"split {i}->{j}"
+    a2a = Schedule(
+        spec,
+        "all-to-all" if C <= 1 else "chunked-all-to-all",
+        _a2a_chunk_steps(L, p, C, what, pad_step, tail),
+        budget,
+        notes=f"C={C} chunks over local axis-{i} extent {concat_extent}" if C > 1 else "",
+    )
+
+    ring_steps: List[Step] = []
+    if pad_step is not None:
+        ring_steps.append(pad_step)
+    blk = L // p
+    for d in range(1, p):
+        ring_steps.append(
+            Step(
+                "ppermute",
+                bytes_moved=blk,
+                peak_bytes=2 * blk,
+                detail=f"hop distance {d}: neighbor block of {what}",
+            )
+        )
+    if tail is not None:
+        ring_steps.append(tail)
+    ring = Schedule(
+        spec,
+        "ring",
+        ring_steps,
+        budget,
+        notes="p-1 ppermute hops, one neighbor block in flight per step",
+    )
+    return [a2a, ring]
+
+
+def _pivot_valid(spec: RedistSpec) -> bool:
+    """The split-0 pivot needs the leading extents to divide the mesh on
+    both sides (device blocks are then contiguous runs of the row-major
+    element order, so the middle reshape is LOCAL)."""
+    p = spec.mesh_size
+    in0 = spec.gshape[0] if spec.gshape else 0
+    out0 = spec.out_shape[0] if spec.out_shape else 0
+    return (
+        len(spec.gshape) >= 1
+        and len(spec.out_shape) >= 1
+        and in0 > 0
+        and out0 > 0
+        and in0 % p == 0
+        and out0 % p == 0
+    )
+
+
+def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
+    p = spec.mesh_size
+    s, t = spec.src_split, spec.dst_split
+    item = spec.itemsize
+    steps: List[Step] = []
+    shard = spec.size // p * item  # logical bytes per device block
+
+    n_coll = 0
+    if s is not None and s != 0:
+        L1 = _prod(
+            [_pad_extent(d, p) if ax == s else d for ax, d in enumerate(spec.gshape)]
+        ) // p * item
+        C1 = _divisor_chunks(
+            _pad_extent(spec.gshape[s], p) // p, -(-2 * L1 // budget)
+        )
+        steps += _a2a_chunk_steps(L1, p, C1, f"split {s}->0 (pivot in)", None, None)
+        n_coll += C1
+        if _pad_extent(spec.gshape[s], p) != spec.gshape[s]:
+            steps.append(
+                Step("slice", peak_bytes=shard, detail=f"drop axis {s} pad (local)")
+            )
+    steps.append(
+        Step(
+            "reshape",
+            peak_bytes=shard,
+            detail="local row-major reshape at full minor-dim width",
+        )
+    )
+    if t is not None and t != 0:
+        out_t, out_tp = spec.out_shape[t], _pad_extent(spec.out_shape[t], p)
+        L2 = _prod(
+            [_pad_extent(d, p) if ax == t else d for ax, d in enumerate(spec.out_shape)]
+        ) // p * item
+        if out_tp != out_t:
+            steps.append(
+                Step(
+                    "pad",
+                    peak_bytes=L2,
+                    detail=f"pad axis {t} {out_t}->{out_tp} (local)",
+                )
+            )
+        C2 = _divisor_chunks(spec.out_shape[0] // p, -(-2 * L2 // budget))
+        steps += _a2a_chunk_steps(L2, p, C2, f"split 0->{t} (pivot out)", None, None)
+        n_coll += C2
+    strategy = "split0-pivot" if n_coll else "local-reshape"
+    return Schedule(
+        spec,
+        strategy,
+        steps,
+        budget,
+        notes="minor-dim packing: heavy copies run on the split-0 layout",
+    )
+
+
+def _gather_reshape_schedule(spec: RedistSpec, budget: int) -> Schedule:
+    p = spec.mesh_size
+    logical = spec.logical_bytes
+    steps = [
+        Step(
+            "all_gather",
+            bytes_moved=logical * (p - 1) // p,
+            peak_bytes=logical,
+            detail="replicate the full operand (fallback: pivot divisibility failed)"
+            if spec.is_reshape
+            else "explicit replicate",
+        )
+    ]
+    if spec.is_reshape:
+        steps.append(Step("reshape", peak_bytes=logical, detail="replicated reshape"))
+    if spec.dst_split is not None:
+        steps.append(
+            Step(
+                "slice",
+                peak_bytes=spec.dst_shard_bytes,
+                detail=f"slice dst shard (split {spec.dst_split})",
+            )
+        )
+    return Schedule(
+        spec,
+        "gather-reshape" if spec.is_reshape else "replicate",
+        steps,
+        budget,
+        notes="full all-gather — the only strategy that materializes the logical array",
+    )
+
+
+def _cost(s: Schedule) -> int:
+    return sum(ALPHA_BYTES + st.bytes_moved for st in s.steps if st.is_collective)
+
+
+def _select(candidates: List[Schedule]) -> Schedule:
+    feasible = [c for c in candidates if c.within_budget]
+    if feasible:
+        return min(feasible, key=_cost)
+    # nothing fits: degrade to the smallest footprint and say so —
+    # rebuilt (not mutated) so plan_id stays the sha1 of the canonical
+    # serialization, notes included
+    best = min(candidates, key=lambda c: c.peak_bytes)
+    notes = (best.notes + "; " if best.notes else "") + (
+        f"over budget: peak {best.peak_bytes} B > {best.budget_bytes} B "
+        "(smallest-footprint candidate chosen)"
+    )
+    return Schedule(best.spec, best.strategy, best.steps, best.budget_bytes, notes=notes)
+
+
+# --------------------------------------------------------------------- #
+# the planner                                                           #
+# --------------------------------------------------------------------- #
+def _build(spec: RedistSpec, budget: int) -> Schedule:
+    p = spec.mesh_size
+
+    if spec.is_reshape:
+        if spec.gshape == spec.reshape_to and spec.src_split == spec.dst_split:
+            return Schedule(spec, "noop", [], budget)
+        if p <= 1 or spec.size == 0:
+            return Schedule(
+                spec,
+                "local",
+                [Step("reshape", peak_bytes=spec.logical_bytes, detail="single-shard reshape")],
+                budget,
+            )
+        if spec.src_split is None:
+            steps = [
+                Step("reshape", peak_bytes=spec.logical_bytes, detail="replicated reshape")
+            ]
+            if spec.dst_split is not None:
+                steps.append(
+                    Step(
+                        "slice",
+                        peak_bytes=spec.dst_shard_bytes,
+                        detail=f"slice dst shard (split {spec.dst_split})",
+                    )
+                )
+            return Schedule(spec, "local-reshape", steps, budget)
+        if spec.dst_split is None:
+            return _gather_reshape_schedule(spec, budget)
+        candidates = []
+        if _pivot_valid(spec):
+            candidates.append(_pivot_schedule(spec, budget))
+        candidates.append(_gather_reshape_schedule(spec, budget))
+        return _select(candidates)
+
+    # pure resplit
+    if spec.src_split == spec.dst_split:
+        return Schedule(spec, "noop", [], budget)
+    if p <= 1 or spec.size == 0:
+        return Schedule(spec, "local", [], budget)
+    if spec.src_split is None:
+        return Schedule(
+            spec,
+            "slice",
+            [
+                Step(
+                    "slice",
+                    peak_bytes=spec.dst_shard_bytes,
+                    detail=f"local shard slice (split {spec.dst_split})",
+                )
+            ],
+            budget,
+        )
+    if spec.dst_split is None:
+        return _gather_reshape_schedule(spec, budget)
+    return _select(_resplit_candidates(spec, budget))
+
+
+def plan(spec: RedistSpec, budget: Optional[int] = None) -> Schedule:
+    """Plan ``spec`` under ``budget`` bytes (default: the env knob).
+    Cached per (spec, budget); cache hits/misses and the planned
+    byte/step/peak totals feed the telemetry registry."""
+    b = budget_bytes() if budget is None else int(budget)
+    key = (spec, b)
+    with _plan_lock:
+        cached = _plan_cache.get(key)
+    if cached is not None:
+        if _telemetry._ENABLED:
+            _telemetry.inc("redist.plan_cache.hit")
+        return cached
+    sched = _build(spec, b)
+    with _plan_lock:
+        if len(_plan_cache) >= _PLAN_CACHE_MAX:
+            _plan_cache.pop(next(iter(_plan_cache)))
+        _plan_cache[key] = sched
+    if _telemetry._ENABLED:
+        _telemetry.inc("redist.plan_cache.miss")
+        _telemetry.inc("redist.planned_bytes", sched.bytes_moved)
+        _telemetry.inc("redist.steps", sched.n_steps)
+        _telemetry.inc("redist.peak_bytes", sched.peak_bytes)
+        _obs_events.emit(
+            "redist.plan",
+            plan_id=sched.plan_id,
+            strategy=sched.strategy,
+            spec=repr(sched.spec),
+            steps=sched.n_steps,
+            collectives=sched.collective_counts(),
+            peak_bytes=sched.peak_bytes,
+            budget_bytes=b,
+        )
+    return sched
+
+
+def explain(arr, axis=None, *, reshape=None, new_split=None) -> Schedule:
+    """The chosen redistribution plan for ``arr`` — without executing it.
+
+    ``explain(arr, axis)`` plans the resplit to ``axis``;
+    ``explain(arr, reshape=shape, new_split=...)`` plans the
+    reshape-with-repartition (``new_split`` defaults the same way
+    ``ht.reshape`` defaults it). Returns the
+    :class:`~heat_tpu.redistribution.schedule.Schedule` the executor
+    would compile — strategy, steps, per-step peak-memory accounting,
+    plan id.
+    """
+    from ..core.dndarray import DNDarray
+    from ..core.stride_tricks import sanitize_axis
+
+    if not planner_enabled():
+        raise RuntimeError(
+            "explain: the redistribution planner is disabled "
+            f"({_ENABLE_ENV}=0) — resplit/reshape run the legacy "
+            "one-collective paths, so there is no plan to show. Unset "
+            f"{_ENABLE_ENV} to re-enable planner routing."
+        )
+    if not isinstance(arr, DNDarray):
+        raise TypeError(f"explain expects a DNDarray, got {type(arr)}")
+    if arr._is_planar:
+        raise TypeError(
+            "explain: planar-complex arrays take the legacy relayout path "
+            "(the planner routes real/physical layouts only)"
+        )
+    if reshape is not None:
+        # THE resolver the public call uses — explain must build its
+        # spec from exactly the (shape, new_split) ht.reshape executes
+        from ..core.manipulations import _normalize_reshape_args
+
+        shape, new_split = _normalize_reshape_args(arr, (tuple(reshape),) if isinstance(
+            reshape, (tuple, list)
+        ) else (reshape,), new_split)
+        spec = RedistSpec.normalize(
+            arr.gshape,
+            np.dtype(arr._phys.dtype).name,
+            arr.split,
+            new_split,
+            arr.comm.size,
+            reshape_to=shape,
+        )
+    else:
+        axis = sanitize_axis(arr.gshape, axis)
+        spec = RedistSpec.normalize(
+            arr.gshape, np.dtype(arr._phys.dtype).name, arr.split, axis, arr.comm.size
+        )
+    return plan(spec)
+
+
+# --------------------------------------------------------------------- #
+# golden matrix — pinned by tier-1 and the ci.sh determinism leg        #
+# --------------------------------------------------------------------- #
+def golden_specs() -> List[Tuple[str, RedistSpec]]:
+    """The (name, spec) matrix whose plans are golden: strategies and
+    step counts are pinned in ``tests/test_redistribution.py`` and the
+    serialized plans must be byte-identical run-to-run (ci.sh diffs two
+    runs of ``scripts/redist_plans.py``)."""
+    S = RedistSpec.normalize
+    return [
+        ("noop_same_split", S((64, 48), "float32", 1, 1, 8)),
+        ("resplit_0_to_1_p8", S((64, 48), "float32", 0, 1, 8)),
+        ("resplit_1_to_0_p8", S((64, 48), "float32", 1, 0, 8)),
+        ("resplit_0_to_1_int32_p4", S((64, 48), "int32", 0, 1, 4)),
+        ("resplit_uneven_p8", S((63, 48), "float32", 0, 1, 8)),
+        ("resplit_3d_1_to_2_p8", S((16, 24, 40), "float32", 1, 2, 8)),
+        ("replicate_p8", S((64, 48), "float32", 0, None, 8)),
+        ("slice_from_replicated_p8", S((64, 48), "float32", None, 1, 8)),
+        ("mesh1_resplit", S((64, 48), "float32", 0, 1, 1)),
+        ("resplit_chunked_2gb_p8", S((32768, 16384), "float32", 0, 1, 8)),
+        ("resplit_ring_8gb_p8", S((131072, 16384), "float32", 0, 1, 8)),
+        ("reshape_pivot_p8", S((40960, 40), "float32", 1, 1, 8, reshape_to=(20480, 80))),
+        ("reshape_split0_local_p8", S((64, 48), "float32", 0, 0, 8, reshape_to=(32, 96))),
+        (
+            "reshape_gather_fallback_p8",
+            S((1000, 26), "float32", 1, 1, 8, reshape_to=(26, 1000)),
+        ),
+        (
+            "reshape_split1_1gb_p8",
+            S((1000, 250000), "float32", 1, 1, 8, reshape_to=(10_000_000, 25)),
+        ),
+    ]

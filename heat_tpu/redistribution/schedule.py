@@ -1,0 +1,188 @@
+"""Schedule IR — the inspectable, golden-testable plan representation.
+
+A :class:`Schedule` is a strategy name plus an ordered list of
+:class:`Step`\\ s (slice → collective → concat), each carrying
+
+- ``bytes_moved`` — the per-device payload the step ships across the
+  mesh (0 for local copy steps), and
+- ``peak_bytes`` — the per-device TRANSIENT buffer the step needs on
+  top of the resident source/destination shards (send+recv buffers for
+  collectives, the output buffer for local relayout copies).
+
+``Schedule.peak_bytes`` (max over steps) is what the planner holds
+under the ``HEAT_TPU_REDIST_BUDGET_MB`` budget by chunking collectives;
+``Schedule.collective_counts()`` is the exact HLO collective census the
+executor's compiled program must match — tier-1 pins that equality for
+the golden specs (arXiv:2112.01075's "the schedule is checkable before
+it runs").
+
+Plans serialize canonically (``canonical_json``): byte-identical
+run-to-run for the same spec + budget, since the ``plan_id`` derived
+from that serialization keys the executor's program cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .spec import RedistSpec
+
+__all__ = ["Step", "Schedule", "COLLECTIVE_STEP_KINDS"]
+
+# step kind -> HLO collective op it must compile to (1:1). Every other
+# kind is a local copy/view and must emit NO collective.
+COLLECTIVE_STEP_KINDS: Dict[str, str] = {
+    "all_to_all": "all-to-all",
+    "all_gather": "all-gather",
+    "ppermute": "collective-permute",
+}
+
+_LOCAL_STEP_KINDS = ("slice", "pad", "reshape", "concat", "pack")
+
+
+class Step:
+    """One schedule step.
+
+    Attributes
+    ----------
+    kind : ``all_to_all`` | ``all_gather`` | ``ppermute`` | ``slice`` |
+        ``pad`` | ``reshape`` | ``concat`` | ``pack``.
+    bytes_moved : per-device payload crossing the mesh (collectives;
+        0 for local steps).
+    peak_bytes : per-device transient buffer bytes of this step.
+    detail : short human-readable description of what the step does.
+    chunk : chunk index when the step is one lap of a chunked pipeline.
+    """
+
+    __slots__ = ("kind", "bytes_moved", "peak_bytes", "detail", "chunk")
+
+    def __init__(
+        self,
+        kind: str,
+        bytes_moved: int = 0,
+        peak_bytes: int = 0,
+        detail: str = "",
+        chunk: Optional[int] = None,
+    ):
+        if kind not in COLLECTIVE_STEP_KINDS and kind not in _LOCAL_STEP_KINDS:
+            raise ValueError(f"unknown step kind {kind!r}")
+        self.kind = kind
+        self.bytes_moved = int(bytes_moved)
+        self.peak_bytes = int(peak_bytes)
+        self.detail = detail
+        self.chunk = chunk
+
+    @property
+    def is_collective(self) -> bool:
+        return self.kind in COLLECTIVE_STEP_KINDS
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "bytes_moved": self.bytes_moved,
+            "peak_bytes": self.peak_bytes,
+            "detail": self.detail,
+            "chunk": self.chunk,
+        }
+
+    def __repr__(self) -> str:
+        c = f"[{self.chunk}]" if self.chunk is not None else ""
+        return f"Step({self.kind}{c}, moved={self.bytes_moved}, peak={self.peak_bytes})"
+
+
+class Schedule:
+    """An ordered redistribution plan for one :class:`RedistSpec`."""
+
+    def __init__(
+        self,
+        spec: RedistSpec,
+        strategy: str,
+        steps: List[Step],
+        budget_bytes: int,
+        notes: str = "",
+    ):
+        self.spec = spec
+        self.strategy = strategy
+        self.steps: List[Step] = list(steps)
+        self.budget_bytes = int(budget_bytes)
+        self.notes = notes
+        self.plan_id = hashlib.sha1(
+            self.canonical_json(with_plan_id=False).encode()
+        ).hexdigest()[:12]
+
+    # ------------------------------------------------------------------ #
+    # accounting                                                         #
+    # ------------------------------------------------------------------ #
+    @property
+    def peak_bytes(self) -> int:
+        """Max per-device transient footprint over all steps."""
+        return max((s.peak_bytes for s in self.steps), default=0)
+
+    @property
+    def bytes_moved(self) -> int:
+        """Total per-device payload shipped across the mesh."""
+        return sum(s.bytes_moved for s in self.steps)
+
+    @property
+    def n_steps(self) -> int:
+        return len(self.steps)
+
+    @property
+    def n_collectives(self) -> int:
+        return sum(1 for s in self.steps if s.is_collective)
+
+    @property
+    def within_budget(self) -> bool:
+        return self.peak_bytes <= self.budget_bytes
+
+    def collective_counts(self) -> Dict[str, int]:
+        """{HLO op name: count} the executed program must launch —
+        directly comparable with
+        ``ht.observability.collective_counts(...).counts``."""
+        out: Dict[str, int] = {}
+        for s in self.steps:
+            if s.is_collective:
+                op = COLLECTIVE_STEP_KINDS[s.kind]
+                out[op] = out.get(op, 0) + 1
+        return out
+
+    # ------------------------------------------------------------------ #
+    # serialization                                                      #
+    # ------------------------------------------------------------------ #
+    def as_dict(self, with_plan_id: bool = True) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "spec": self.spec.as_dict(),
+            "strategy": self.strategy,
+            "budget_bytes": self.budget_bytes,
+            "steps": [s.as_dict() for s in self.steps],
+            "peak_bytes": self.peak_bytes,
+            "bytes_moved": self.bytes_moved,
+            "collective_counts": self.collective_counts(),
+            "within_budget": self.within_budget,
+            "notes": self.notes,
+        }
+        if with_plan_id:
+            d["plan_id"] = self.plan_id
+        return d
+
+    def canonical_json(self, with_plan_id: bool = True) -> str:
+        """Deterministic serialization — byte-identical run-to-run for
+        the same (spec, budget); ci.sh diffs two runs of the golden
+        matrix against each other."""
+        return json.dumps(
+            self.as_dict(with_plan_id=with_plan_id),
+            sort_keys=True,
+            separators=(",", ":"),
+        )
+
+    def __repr__(self) -> str:
+        kinds = [
+            s.kind + (f"[{s.chunk}]" if s.chunk is not None else "") for s in self.steps
+        ]
+        return (
+            f"Schedule({self.strategy}, plan={self.plan_id}, {self.spec!r}, "
+            f"steps={kinds}, peak={self.peak_bytes}B/{self.budget_bytes}B)"
+        )

@@ -1,0 +1,143 @@
+"""Normalized redistribution problem statement — the planner's cache key.
+
+Every split change in the framework (``resplit``/``resplit_``, the
+``reshape(..., new_split=)`` repartition, ``communication.reshard_phys``)
+is first normalized to one :class:`RedistSpec`: global shape, dtype,
+source/destination split, mesh size, and — for the reshape repartition —
+the target shape. Two call sites asking for the same movement produce
+the SAME spec, so plans (``planner.plan``) and compiled executor
+programs (``executor``) cache per spec, not per call site.
+
+The spec is deliberately value-free: no arrays, no mesh object, no
+device identities. Mesh geometry enters only as ``mesh_size`` (what the
+chunk math depends on); the executor binds a concrete mesh at program
+build time and registers its cache with
+``communication.register_mesh_cache`` for world rebuilds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from typing import Optional, Tuple
+
+__all__ = ["RedistSpec"]
+
+
+def _prod(shape: Tuple[int, ...]) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+@dataclasses.dataclass(frozen=True)
+class RedistSpec:
+    """One redistribution problem, normalized and hashable.
+
+    Attributes
+    ----------
+    gshape : global (logical) shape of the source array.
+    dtype : canonical numpy dtype name of the physical array.
+    src_split / dst_split : heat split axes (already modded into range),
+        ``None`` for replicated.
+    mesh_size : number of shards on the 1-D mesh axis.
+    reshape_to : target global shape when the movement is a
+        reshape-with-repartition (``dst_split`` then indexes this shape);
+        ``None`` for a pure resplit.
+    """
+
+    gshape: Tuple[int, ...]
+    dtype: str
+    src_split: Optional[int]
+    dst_split: Optional[int]
+    mesh_size: int
+    reshape_to: Optional[Tuple[int, ...]] = None
+
+    # ------------------------------------------------------------------ #
+    # construction                                                       #
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def normalize(
+        cls,
+        gshape,
+        dtype,
+        src_split: Optional[int],
+        dst_split: Optional[int],
+        mesh_size: int,
+        reshape_to=None,
+    ) -> "RedistSpec":
+        """Build a spec with axes modded into range and types canonical."""
+        gshape = tuple(int(s) for s in gshape)
+        out_shape = None if reshape_to is None else tuple(int(s) for s in reshape_to)
+        if out_shape is not None and _prod(out_shape) != _prod(gshape):
+            raise ValueError(
+                f"cannot redistribute-reshape {gshape} into {out_shape}: sizes differ"
+            )
+        ndim_src = max(len(gshape), 1)
+        ndim_dst = max(len(out_shape if out_shape is not None else gshape), 1)
+        if src_split is not None:
+            src_split = int(src_split) % ndim_src
+        if dst_split is not None:
+            dst_split = int(dst_split) % ndim_dst
+        return cls(
+            gshape=gshape,
+            dtype=np.dtype(dtype).name,
+            src_split=src_split,
+            dst_split=dst_split,
+            mesh_size=int(mesh_size),
+            reshape_to=out_shape,
+        )
+
+    # ------------------------------------------------------------------ #
+    # derived geometry                                                   #
+    # ------------------------------------------------------------------ #
+    @property
+    def out_shape(self) -> Tuple[int, ...]:
+        return self.reshape_to if self.reshape_to is not None else self.gshape
+
+    @property
+    def is_reshape(self) -> bool:
+        return self.reshape_to is not None
+
+    @property
+    def itemsize(self) -> int:
+        return np.dtype(self.dtype).itemsize
+
+    @property
+    def size(self) -> int:
+        return _prod(self.gshape)
+
+    @property
+    def logical_bytes(self) -> int:
+        """Bytes of the whole logical array."""
+        return self.size * self.itemsize
+
+    @property
+    def dst_shard_bytes(self) -> int:
+        """Per-device bytes of one (padded) shard of the destination."""
+        from ..core import _padding
+
+        if self.dst_split is None or self.mesh_size <= 1:
+            return self.logical_bytes
+        phys = _padding.phys_shape(self.out_shape, self.dst_split, self.mesh_size)
+        return _prod(phys) * self.itemsize // self.mesh_size
+
+    def as_dict(self) -> dict:
+        return {
+            "gshape": list(self.gshape),
+            "dtype": self.dtype,
+            "src_split": self.src_split,
+            "dst_split": self.dst_split,
+            "mesh_size": self.mesh_size,
+            "reshape_to": None if self.reshape_to is None else list(self.reshape_to),
+        }
+
+    def __repr__(self) -> str:
+        move = f"split {self.src_split}->{self.dst_split}"
+        shape = f"{self.gshape}"
+        if self.is_reshape:
+            shape += f"->{self.reshape_to}"
+        return f"RedistSpec({shape} {self.dtype}, {move}, p={self.mesh_size})"

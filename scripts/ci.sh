@@ -47,6 +47,16 @@ python scripts/lint.py heat_tpu/
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
   python scripts/lint.py --ir-entry 8
 
+# golden-plan determinism: redistribution plans key the executor's
+# program cache, so two fresh processes must serialize the golden
+# matrix byte-identically (leg 7)
+plans_a="$(mktemp)"; plans_b="$(mktemp)"
+python scripts/redist_plans.py > "$plans_a"
+python scripts/redist_plans.py > "$plans_b"
+diff "$plans_a" "$plans_b"
+echo "redist golden plans: deterministic ($(wc -l < "$plans_a") plans)"
+rm -f "$plans_a" "$plans_b"
+
 if [ -f BENCH_DETAIL.json ] && ls BENCH_r*.json >/dev/null 2>&1; then
   python scripts/bench_compare.py
 fi

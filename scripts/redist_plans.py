@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Dump the canonical serialization of every golden redistribution plan.
+
+The ci.sh determinism leg runs this twice and diffs the output: plans
+key the executor's program cache (``plan_id`` = sha1 of the canonical
+serialization), so they must be byte-identical run-to-run — any
+nondeterminism in the planner (dict ordering, float formatting,
+environment leakage) shows up here as a diff before it can show up as a
+phantom cache miss or a flapping golden test.
+
+Pure Python: no mesh, no jax device work — safe on any container.
+"""
+
+import sys
+
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from heat_tpu.redistribution import planner
+
+    # the default budget, pinned explicitly so an ambient
+    # HEAT_TPU_REDIST_BUDGET_MB cannot make two CI runs diverge
+    budget = planner.DEFAULT_BUDGET_MB << 20
+    for name, spec in planner.golden_specs():
+        sched = planner.plan(spec, budget)
+        print(f"{name}\t{sched.canonical_json()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,20 +13,27 @@ import heat_tpu as ht
 def bad_program(x, debug=False):
     """Three violations in one program:
 
-    - SL101: ``resplit(1)`` relayouts the full operand through an
-      all-to-all nothing in the math required (the result is consumed at
-      split=1, so XLA cannot elide the exchange);
-    - SL102: ``resplit(None)`` materializes a replicated copy of the
-      whole array (an all-gather of every byte);
+    - SL101: a bare sharding constraint pins the operand to the OTHER
+      split mid-expression — an implicit GSPMD all-to-all no plan
+      issued. (The public ``resplit`` no longer models this: it routes
+      through ``ht.redistribution`` whose programs stamp their plan id
+      into the HLO and downgrade to info — the accident this rule
+      exists for is exactly the UNstamped relayout.)
+    - SL102: a replicated constraint materializes a copy of the whole
+      array (an all-gather of every byte);
     - SL105: the replicated output has the same aval as the argument but
       the buffer is not donated;
     - SL106: the debug arm reads the device value on the host — never
       taken at trace time, only the source scan can see it.
     """
-    y = ht.exp(x.resplit(1))
-    z = x.resplit(None)
+    import jax.numpy as jnp
+    from jax import lax
+
+    phys = x._phys
+    y = jnp.exp(lax.with_sharding_constraint(phys, x.comm.sharding(phys.ndim, 1)))
+    z = lax.with_sharding_constraint(phys, x.comm.sharding(phys.ndim, None))
     if debug:
-        host = jax.device_get(z._phys)  # shardlint: ignore[SL201] -- fixture
+        host = jax.device_get(z)  # shardlint: ignore[SL201] -- fixture
         print(float(host.sum()))
     return y, z
 

@@ -284,22 +284,38 @@ class TestCollectiveCounts(TelemetryCase):
         self.assertEqual(rep.bytes_by_op["all-gather"], 320 * P * 2 * P * 4)
 
     @pytest.mark.skipif(P < 2, reason="needs a real mesh")
-    def test_reshape_split1_collective_baseline(self):
-        """ROADMAP `reshape` baseline (alongside its hbm_frac row): the
-        split=1 repartition TODAY compiles to ONE all-gather of the FULL
-        operand — p x the 2*bytes bound a layout-aware repartition
-        (tile-transposing copy / minor-dim packing) should approach.
-        When that lands, this pin flips to an all-to-all and the gather
-        count drops to zero; update both assertions deliberately."""
+    def test_reshape_split1_planned_schedule(self):
+        """ROADMAP `reshape`: the split=1 repartition is planner-routed
+        (ht.redistribution split-0 pivot — minor-dim packing) and must
+        compile to exactly the plan's collective census: all-to-all in,
+        LOCAL full-width reshape, all-to-all out, ZERO all-gathers. The
+        pre-planner baseline (one all-gather of the FULL operand, pinned
+        here until PR 3) stays as a strict `>` regression bound on the
+        per-device bytes the schedule ships."""
         x = ht.random.randn(1 << 14, 40, split=1)  # 40 lanes: 8- and 5-mesh divisible
+        plan = ht.redistribution.explain(x, reshape=(1 << 13, 80), new_split=1)
         rep = ht.observability.collective_counts(
             lambda v: ht.reshape(v, (1 << 13, 80), new_split=1), x
         )
-        self.assertEqual(rep.counts["all-gather"], 1)
-        self.assertEqual(rep.counts["all-to-all"], 0)
-        self.assertEqual(rep.total, 1)
-        # the gather assembles every logical byte on every device
-        self.assertEqual(rep.bytes_by_op["all-gather"], (1 << 14) * 40 * 4)
+        # executed HLO census == plan census, exactly, on ANY mesh
+        census = plan.collective_counts()
+        for op in ("all-gather", "all-to-all", "collective-permute"):
+            self.assertEqual(rep.counts[op], census.get(op, 0), op)
+        self.assertEqual(rep.total, plan.n_collectives)
+        old_baseline_bytes = (1 << 14) * 40 * 4
+        if (1 << 14) % P or (1 << 13) % P:
+            # indivisible leading extents (the 5-device leg): the pivot is
+            # ruled out and the planner EXPLICITLY degrades to the old
+            # gather — same census as the pre-planner baseline
+            self.assertEqual(plan.strategy, "gather-reshape")
+        else:
+            self.assertEqual(plan.strategy, "split0-pivot")
+            self.assertEqual(rep.counts["all-gather"], 0)
+            # regression bound: the old monolithic gather assembled every
+            # logical byte on every device — the planned schedule must
+            # ship strictly less per device (2/p-ish for the pivot)
+            self.assertGreater(old_baseline_bytes, rep.bytes_by_op["all-to-all"])
+            self.assertGreater(old_baseline_bytes, plan.bytes_moved)
 
     def test_compile_only_no_execution(self):
         # inspection must not execute the program: an fn with a host-side

@@ -1,0 +1,471 @@
+"""Redistribution planner subsystem (``ht.redistribution``): golden
+plans, degenerate specs, executor equivalence, and the plan-census ==
+compiled-HLO contract.
+
+Everything here is CPU-mesh tier-1: plans are pure Python (no device
+work at all), the census checks lower compile-only, and the equivalence
+sweeps run on the virtual 8-device mesh from conftest.py. The golden
+matrix (``planner.golden_specs``) is pinned three ways:
+
+1. strategy + step count + collective census per spec (this file),
+2. byte-identical serialization run-to-run (``scripts/redist_plans.py``
+   diffed twice in ci.sh — plans key the executor's program cache),
+3. compiled-HLO collective counts == the plan's census for every spec
+   that lowers to a planner program (the acceptance criterion).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import heat_tpu as ht
+
+from heat_tpu.core import _padding
+from heat_tpu.core.communication import MeshCommunication
+from heat_tpu.observability.hlo import _count_ops
+from heat_tpu.redistribution import RedistSpec, executor, planner
+from heat_tpu.redistribution.schedule import Schedule, Step
+
+from test_suites.basic_test import TestCase
+
+P = len(jax.devices())
+
+# the default planner budget, passed explicitly so an ambient
+# HEAT_TPU_REDIST_BUDGET_MB cannot skew the golden pins
+BUDGET = planner.DEFAULT_BUDGET_MB << 20
+
+# name -> (strategy, n_steps, collective census) under the default budget
+GOLDEN_PINS = {
+    "noop_same_split": ("noop", 0, {}),
+    "resplit_0_to_1_p8": ("all-to-all", 1, {"all-to-all": 1}),
+    "resplit_1_to_0_p8": ("all-to-all", 1, {"all-to-all": 1}),
+    "resplit_0_to_1_int32_p4": ("all-to-all", 1, {"all-to-all": 1}),
+    "resplit_uneven_p8": ("all-to-all", 2, {"all-to-all": 1}),
+    "resplit_3d_1_to_2_p8": ("all-to-all", 1, {"all-to-all": 1}),
+    "replicate_p8": ("replicate", 1, {"all-gather": 1}),
+    "slice_from_replicated_p8": ("slice", 1, {}),
+    "mesh1_resplit": ("local", 0, {}),
+    "resplit_chunked_2gb_p8": ("chunked-all-to-all", 5, {"all-to-all": 2}),
+    "resplit_ring_8gb_p8": ("ring", 7, {"collective-permute": 7}),
+    "reshape_pivot_p8": ("split0-pivot", 3, {"all-to-all": 2}),
+    "reshape_split0_local_p8": ("local-reshape", 1, {}),
+    "reshape_gather_fallback_p8": ("gather-reshape", 3, {"all-gather": 1}),
+    "reshape_split1_1gb_p8": ("split0-pivot", 8, {"all-to-all": 3}),
+}
+
+
+def _golden():
+    return planner.golden_specs()
+
+
+def _planner_program(comm, spec, budget):
+    """The jitted program the executor would run for ``spec``, or None
+    for the direct-placement strategies (noop/local/slice/replicate)."""
+    strategy = planner.plan(spec, budget).strategy
+    if strategy in ("noop", "local", "slice", "replicate"):
+        return None
+    if strategy in ("all-to-all", "chunked-all-to-all", "ring"):
+        return executor._move_program(comm, spec, budget)
+    if strategy == "split0-pivot":
+        return executor._pivot_program(comm, spec, budget)
+    if strategy == "gather-reshape":
+        return executor._gather_reshape_program(comm, spec, budget)
+    return executor._local_reshape_program(comm, spec, budget)
+
+
+class TestGoldenPlans(TestCase):
+    def test_matrix_covers_the_pins(self):
+        self.assertEqual({n for n, _ in _golden()}, set(GOLDEN_PINS))
+
+    def test_strategy_step_count_and_census_pinned(self):
+        for name, spec in _golden():
+            strategy, n_steps, census = GOLDEN_PINS[name]
+            sched = planner.plan(spec, BUDGET)
+            self.assertEqual(sched.strategy, strategy, name)
+            self.assertEqual(sched.n_steps, n_steps, name)
+            self.assertEqual(sched.collective_counts(), census, name)
+
+    def test_every_plan_fits_the_budget(self):
+        for name, spec in _golden():
+            sched = planner.plan(spec, BUDGET)
+            self.assertTrue(sched.within_budget, f"{name}: {sched!r}")
+            self.assertLessEqual(sched.peak_bytes, BUDGET, name)
+
+    def test_plans_byte_identical_run_to_run(self):
+        """Plans key the executor's program cache, so planning the same
+        spec twice — including across a cache wipe — must serialize to
+        the identical bytes (the ci.sh determinism leg does this across
+        processes)."""
+        first = {n: planner.plan(s, BUDGET).canonical_json() for n, s in _golden()}
+        planner.clear_plan_cache()
+        for name, spec in _golden():
+            self.assertEqual(planner.plan(spec, BUDGET).canonical_json(), first[name])
+
+    def test_1gb_split1_reshape_acceptance(self):
+        """The acceptance spec: the 1 GB split-1 reshape plans to a
+        bounded-footprint pivot whose per-step peak never exceeds the
+        configured budget — not the old full all-gather."""
+        (spec,) = [s for n, s in _golden() if n == "reshape_split1_1gb_p8"]
+        self.assertEqual(spec.logical_bytes, 10**9)
+        sched = planner.plan(spec, planner.budget_bytes())
+        self.assertEqual(sched.strategy, "split0-pivot")
+        for step in sched.steps:
+            self.assertLessEqual(step.peak_bytes, planner.budget_bytes())
+        self.assertEqual(sched.collective_counts().get("all-gather", 0), 0)
+
+    def test_tighter_budget_rechunks(self):
+        """Halving the budget must re-chunk, not blow the budget: the
+        2 GiB resplit pipelines into more laps and the peak drops."""
+        (spec,) = [s for n, s in _golden() if n == "resplit_chunked_2gb_p8"]
+        base = planner.plan(spec, BUDGET)
+        tight = planner.plan(spec, BUDGET // 2)
+        self.assertLessEqual(tight.peak_bytes, BUDGET // 2)
+        self.assertGreater(
+            tight.collective_counts()["all-to-all"],
+            base.collective_counts()["all-to-all"],
+        )
+
+    def test_plan_cache_and_telemetry(self):
+        from heat_tpu.observability import telemetry
+
+        planner.clear_plan_cache()
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            spec = RedistSpec.normalize((64, 48), "float32", 0, 1, 8)
+            planner.plan(spec, BUDGET)
+            planner.plan(spec, BUDGET)
+            snap = telemetry.snapshot()
+            self.assertEqual(snap["counters"]["redist.plan_cache.miss"], 1)
+            self.assertEqual(snap["counters"]["redist.plan_cache.hit"], 1)
+            self.assertGreater(snap["counters"]["redist.planned_bytes"], 0)
+            self.assertGreater(snap["counters"]["redist.steps"], 0)
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+
+class TestScheduleIR(TestCase):
+    def test_unknown_step_kind_rejected(self):
+        with self.assertRaises(ValueError):
+            Step("teleport")
+
+    def test_census_counts_collectives_only(self):
+        spec = RedistSpec.normalize((8, 8), "float32", 0, 1, 8)
+        sched = Schedule(
+            spec,
+            "all-to-all",
+            [Step("pad"), Step("all_to_all", bytes_moved=4), Step("slice")],
+            BUDGET,
+        )
+        self.assertEqual(sched.collective_counts(), {"all-to-all": 1})
+        self.assertEqual(sched.n_collectives, 1)
+        self.assertEqual(sched.bytes_moved, 4)
+
+    def test_plan_id_is_the_serialization_hash_even_over_budget(self):
+        """An infeasible budget annotates the chosen plan's notes — and
+        the plan_id must still be the sha1 of the canonical
+        serialization (consumers correlate serialized plans by
+        recomputing it)."""
+        import hashlib
+
+        sched = planner.plan(RedistSpec.normalize((64, 48), "float32", 0, 1, 8), 8)
+        self.assertIn("over budget", sched.notes)
+        self.assertEqual(
+            sched.plan_id,
+            hashlib.sha1(sched.canonical_json(with_plan_id=False).encode()).hexdigest()[
+                :12
+            ],
+        )
+
+    def test_plan_id_tracks_content(self):
+        spec = RedistSpec.normalize((8, 8), "float32", 0, 1, 8)
+        a = Schedule(spec, "all-to-all", [Step("all_to_all", bytes_moved=4)], BUDGET)
+        b = Schedule(spec, "all-to-all", [Step("all_to_all", bytes_moved=4)], BUDGET)
+        c = Schedule(spec, "all-to-all", [Step("all_to_all", bytes_moved=8)], BUDGET)
+        self.assertEqual(a.plan_id, b.plan_id)
+        self.assertNotEqual(a.plan_id, c.plan_id)
+
+
+class TestSpecNormalization(TestCase):
+    def test_negative_axes_modded(self):
+        spec = RedistSpec.normalize((4, 6), "float32", -1, -2, 8)
+        self.assertEqual((spec.src_split, spec.dst_split), (1, 0))
+
+    def test_reshape_size_mismatch_rejected(self):
+        with self.assertRaises(ValueError):
+            RedistSpec.normalize((4, 6), "float32", 0, 0, 8, reshape_to=(5, 5))
+
+    def test_same_movement_same_spec(self):
+        a = RedistSpec.normalize((64, 48), np.float32, 0, 1, 8)
+        b = RedistSpec.normalize([64, 48], "float32", -2, -1, 8)
+        self.assertEqual(a, b)
+        self.assertEqual(hash(a), hash(b))
+
+
+class TestDegenerateSpecs(TestCase):
+    def test_same_split_is_noop(self):
+        spec = RedistSpec.normalize((64, 48), "float32", 1, 1, 8)
+        sched = planner.plan(spec, BUDGET)
+        self.assertEqual((sched.strategy, sched.n_steps), ("noop", 0))
+
+    def test_mesh1_is_local(self):
+        spec = RedistSpec.normalize((64, 48), "float32", 0, 1, 1)
+        sched = planner.plan(spec, BUDGET)
+        self.assertEqual(sched.strategy, "local")
+        self.assertEqual(sched.collective_counts(), {})
+
+    def test_replicated_to_split_never_communicates(self):
+        spec = RedistSpec.normalize((64, 48), "float32", None, 1, 8)
+        sched = planner.plan(spec, BUDGET)
+        self.assertEqual(sched.strategy, "slice")
+        self.assertEqual(sched.collective_counts(), {})
+
+    def test_replicate_is_exactly_one_all_gather(self):
+        spec = RedistSpec.normalize((64, 48), "float32", 0, None, 8)
+        sched = planner.plan(spec, BUDGET)
+        self.assertEqual(sched.strategy, "replicate")
+        self.assertEqual(sched.collective_counts(), {"all-gather": 1})
+
+    def test_uneven_shards_pad_locally_not_collectively(self):
+        """_padding discipline: the uneven spec adds local pad/slice
+        steps around the SAME single all-to-all — pad never rides a
+        collective."""
+        even = planner.plan(RedistSpec.normalize((64, 48), "float32", 0, 1, 8), BUDGET)
+        uneven = planner.plan(RedistSpec.normalize((63, 48), "float32", 0, 1, 8), BUDGET)
+        self.assertEqual(uneven.collective_counts(), even.collective_counts())
+        self.assertGreater(uneven.n_steps, even.n_steps)
+        self.assertTrue(any(s.kind == "slice" for s in uneven.steps))
+
+
+class TestExplain(TestCase):
+    def test_explain_resplit(self):
+        x = ht.zeros((64, 48), split=0)
+        sched = planner.explain(x, 1)
+        self.assertIsInstance(sched, Schedule)
+        self.assertEqual(sched.spec.src_split, 0)
+        self.assertEqual(sched.spec.dst_split, 1)
+        if P >= 2:
+            self.assertEqual(sched.strategy, "all-to-all")
+
+    def test_explain_is_the_public_api(self):
+        x = ht.zeros((64, 48), split=0)
+        self.assertEqual(
+            ht.redistribution.explain(x, 1).plan_id, planner.explain(x, 1).plan_id
+        )
+
+    def test_explain_reshape_defaults_new_split_like_reshape(self):
+        x = ht.zeros((64, 48), split=1)
+        sched = planner.explain(x, reshape=(32, 96))
+        self.assertEqual(sched.spec.reshape_to, (32, 96))
+        self.assertEqual(sched.spec.dst_split, 1)
+        inferred = planner.explain(x, reshape=(64 * 48,))
+        self.assertEqual(inferred.spec.dst_split, 0)
+
+    def test_explain_reshape_minus_one(self):
+        x = ht.zeros((64, 48), split=0)
+        sched = planner.explain(x, reshape=(-1, 96))
+        self.assertEqual(sched.spec.reshape_to, (32, 96))
+
+    def test_explain_rejects_non_dndarray(self):
+        with self.assertRaises(TypeError):
+            planner.explain(np.zeros((4, 4)), 1)
+
+
+@pytest.mark.skipif(P < 2, reason="needs a real mesh")
+class TestExecutorEquivalence(TestCase):
+    """The planned schedules must produce bit-identical arrays to the
+    oracle (and therefore to the legacy direct-placement resplit)."""
+
+    def test_resplit_sweep(self):
+        shapes = [(64, 48), (63, 41), (16, 24, 40), (40,), (7, 5)]
+        for shape in shapes:
+            oracle = np.arange(int(np.prod(shape)), dtype=np.float32).reshape(shape)
+            splits = [None] + list(range(len(shape)))
+            for src in splits:
+                for dst in splits:
+                    x = ht.array(oracle, split=src)
+                    self.assert_array_equal(x.resplit(dst), oracle)
+
+    def test_resplit_int_dtype(self):
+        oracle = np.arange(64 * 48, dtype=np.int32).reshape(64, 48)
+        x = ht.array(oracle, split=0)
+        self.assert_array_equal(x.resplit(1), oracle)
+
+    def test_resplit_matches_legacy_path(self):
+        """Planner output == the legacy unpad->repad placement, shard
+        for shard (assert_array_equal checks per-device shards)."""
+        oracle = np.arange(63 * 48, dtype=np.float32).reshape(63, 48)
+        x = ht.array(oracle, split=0)
+        planned = executor.resplit_phys(self.comm, x._phys, (63, 48), 0, 1)
+        legacy = executor._reshard_direct(self.comm, x._phys, (63, 48), 0, 1)
+        np.testing.assert_array_equal(np.asarray(planned), np.asarray(legacy))
+
+    def test_reshape_sweep(self):
+        cases = [
+            ((64, 48), (32, 96), 1),
+            ((64, 48), (96, 32), 0),
+            ((1024, 40), (512, 80), 1),
+            ((64, 48), (64 * 48,), 0),
+            ((1000, 26), (26, 1000), 1),  # gather-reshape fallback
+        ]
+        for in_shape, out_shape, new_split in cases:
+            for src in [None] + list(range(len(in_shape))):
+                oracle = np.arange(int(np.prod(in_shape)), dtype=np.float32).reshape(
+                    in_shape
+                )
+                x = ht.array(oracle, split=src)
+                got = ht.reshape(x, out_shape, new_split=new_split)
+                self.assertEqual(got.split, new_split)
+                self.assert_array_equal(got, oracle.reshape(out_shape))
+
+    def test_chunked_and_ring_numerics(self):
+        """Tiny explicit budgets force the chunked pipeline and the
+        ppermute ring; both must reproduce the oracle exactly."""
+        oracle = np.arange(64 * 48, dtype=np.float32).reshape(64, 48)
+        x = ht.array(oracle, split=0)
+        spec = RedistSpec.normalize((64, 48), "float32", 0, 1, P)
+        seen = set()
+        for budget in (384, 1024, 2048):
+            sched = planner.plan(spec, budget)
+            seen.add(sched.strategy)
+            y = executor.execute(self.comm, x._phys, spec, sched)
+            got = np.asarray(_padding.unpad(y, (64, 48), 1))
+            np.testing.assert_array_equal(got, oracle)
+        if P == 8:
+            self.assertIn("ring", seen)
+            self.assertIn("chunked-all-to-all", seen)
+
+    def test_zero_size_and_scalarish(self):
+        z = ht.zeros((0, 4), split=0)
+        self.assertEqual(z.resplit(1).split, 1)
+        one = ht.zeros((1, 1), split=0)
+        self.assert_array_equal(one.resplit(1), np.zeros((1, 1), np.float32))
+
+    def test_escape_hatch_restores_legacy(self):
+        """HEAT_TPU_REDIST_PLANNER=0 must bypass the planner and still
+        produce correct results."""
+        oracle = np.arange(64 * 48, dtype=np.float32).reshape(64, 48)
+        old = os.environ.get("HEAT_TPU_REDIST_PLANNER")
+        os.environ["HEAT_TPU_REDIST_PLANNER"] = "0"
+        try:
+            self.assertFalse(planner.planner_enabled())
+            x = ht.array(oracle, split=0)
+            self.assert_array_equal(x.resplit(1), oracle)
+            self.assert_array_equal(
+                ht.reshape(x, (32, 96), new_split=1), oracle.reshape(32, 96)
+            )
+            # explain refuses: the plan it would show is not what runs
+            with self.assertRaises(RuntimeError):
+                planner.explain(x, 1)
+        finally:
+            if old is None:
+                del os.environ["HEAT_TPU_REDIST_PLANNER"]
+            else:
+                os.environ["HEAT_TPU_REDIST_PLANNER"] = old
+        self.assertTrue(planner.planner_enabled())
+
+
+@pytest.mark.skipif(P < 8, reason="golden census pins assume the 8-device mesh")
+class TestCompiledCensusMatchesPlan(TestCase):
+    """Acceptance criterion: for every golden spec that lowers to a
+    planner program, the compiled HLO's collective counts equal the
+    plan's census EXACTLY — compile-only, nothing executes (the 4 GB /
+    32 GB / 1 GB specs never allocate)."""
+
+    def _comm_for(self, mesh_size):
+        if mesh_size == self.comm.size:
+            return self.comm
+        if mesh_size <= len(jax.devices()):
+            return MeshCommunication(jax.devices()[:mesh_size])
+        return None
+
+    def test_census(self):
+        checked = 0
+        for name, spec in _golden():
+            comm = self._comm_for(spec.mesh_size)
+            if comm is None:
+                continue
+            prog = _planner_program(comm, spec, BUDGET)
+            if prog is None:
+                continue
+            sched = planner.plan(spec, BUDGET)
+            phys = _padding.phys_shape(spec.gshape, spec.src_split, spec.mesh_size)
+            arg = jax.ShapeDtypeStruct(
+                phys,
+                np.dtype(spec.dtype),
+                sharding=comm.sharding(len(phys), spec.src_split),
+            )
+            text = prog.lower(arg).compile().as_text()
+            counts = {k: v for k, v in _count_ops(text).items() if v}
+            self.assertEqual(counts, sched.collective_counts(), name)
+            checked += 1
+        # the matrix must actually exercise the program-backed strategies
+        self.assertGreaterEqual(checked, 9)
+
+    def test_executed_resplit_census_matches_plan(self):
+        """End-to-end: the census of the PUBLIC resplit call equals the
+        plan explain() returns for the same array."""
+        x = ht.zeros((320 * P, 2 * P), split=0)
+        sched = ht.redistribution.explain(x, 1)
+        rep = ht.observability.collective_counts(lambda v: v.resplit(1), x)
+        for op, n in sched.collective_counts().items():
+            self.assertEqual(rep.counts[op], n)
+        self.assertEqual(rep.total, sched.n_collectives)
+
+
+class TestShardlintIntegration(TestCase):
+    def test_executor_registered_as_planner_module(self):
+        """boundaries.PLANNER_MODULES declares the one module whose
+        collectives are cost-modeled movement by contract; the HLO
+        marker parser recognizes the executor's named_scope stamp."""
+        from heat_tpu.analysis import boundaries
+
+        self.assertIn("redistribution/executor.py", boundaries.PLANNER_MODULES)
+        self.assertEqual(
+            boundaries.planned_reshard_plan_id(
+                'metadata={op_name="jit(fn)/redist_plan_0123456789ab/all_to_all"}'
+            ),
+            "0123456789ab",
+        )
+        self.assertIsNone(boundaries.planned_reshard_plan_id("%all-to-all.1 = ..."))
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_explicit_replicate_reports_as_info(self):
+        """resplit(None) is the planner's explicit replicate strategy;
+        its full all-gather must carry the plan stamp and report as
+        SL102 info, not an error-severity replicated materialization."""
+        x = ht.zeros((4096, 2048), split=0)  # 32 MB: over every threshold
+        sched = ht.redistribution.explain(x, None)
+        self.assertEqual(sched.strategy, "replicate")
+        rep = ht.analysis.check(lambda v: v.resplit(None) * 2.0, x)
+        sl102 = [f for f in rep.findings if f.rule == "SL102"]
+        self.assertTrue(sl102)
+        for f in sl102:
+            self.assertEqual(f.severity, "info")
+            self.assertIn(sched.plan_id, f.message)
+        self.assertTrue(rep.ok)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_planner_reshards_report_as_info(self):
+        """SL101 on a planner-issued all-to-all downgrades to info with
+        the plan id attached — the subsystem's own schedules are not
+        implicit reshards."""
+        x = ht.zeros((4096, 2048), split=0)  # 32 MB: over every threshold
+        sched = ht.redistribution.explain(x, 1)
+        rep = ht.analysis.check(lambda v: v.resplit(1), x)
+        sl101 = [f for f in rep.findings if f.rule == "SL101"]
+        self.assertTrue(sl101)
+        for f in sl101:
+            self.assertEqual(f.severity, "info")
+            self.assertIn(sched.plan_id, f.message)
+        self.assertTrue(rep.ok)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
