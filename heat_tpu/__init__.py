@@ -39,6 +39,7 @@ from . import analysis
 from . import classification
 from . import cluster
 from . import graph
+from . import kernels
 from . import naive_bayes
 from . import nn
 from . import observability

@@ -102,6 +102,15 @@ HOST_BOUNDARIES: Dict[str, Tuple[str, str, str]] = {
         "row fetches instead of a gather); a traced q is rejected with a "
         "TypeError before this read",
     ),
+    "sort-autotune-sync": (
+        "kernels/sort.py",
+        "_sync_scalar",
+        "the sort-kernel autotuner times candidate local-sort paths ONCE "
+        "per (n, dtype) and caches the winner; the scalar read-back is "
+        "the completion fence for each timed probe (block_until_ready is "
+        "a no-op over the remote tunnel — bench.py methodology). Runs "
+        "only eagerly on TPU, never inside a trace",
+    ),
 }
 
 

@@ -584,8 +584,7 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
         idx = DNDarray(si, a.gshape, types.canonical_heat_type(si.dtype), axis, a.device, a.comm)
         if descending:
             vals, idx = flip(vals, axis), flip(idx, axis)
-    elif descending or a.dtype in (types.complex64, types.complex128):
-        # stable-descending keeps tie order (flip would reverse it) and
+    elif a.dtype in (types.complex64, types.complex128):
         # lax.sort has no complex key support — the two-pass path stays
         arr = a.larray
         indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
@@ -593,14 +592,19 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
         vals = _wrap(values, a.split, a, dtype=a.dtype)
         idx = _wrap(indices.astype(types.index_jax_type()), a.split, a)
     else:
-        # one lax.sort carrying the iota returns values AND argsort
-        # indices together — argsort + take_along_axis costs a second
-        # sort-sized gather pass (measured 3.2x the sort floor on v5e)
-        arr = a.larray
-        idt = jnp.int32 if arr.shape[axis] < 2**31 else types.index_jax_type()
-        iota = jax.lax.broadcasted_iota(idt, arr.shape, axis)
-        values, indices = jax.lax.sort(
-            (arr, iota), dimension=axis, num_keys=1, is_stable=True
+        # the fused values+argsort local sort (heat_tpu.kernels.sort):
+        # ONE pass returning values AND stable argsort indices together —
+        # argsort + take_along_axis costs a second sort-sized gather pass
+        # (measured 3.2x the sort floor on v5e), and stable-DESCENDING
+        # rides the same single pass on the complemented key transform
+        # (the old two-pass "keep tie order" route is gone). Kernel paths
+        # (radix / blocked columnsort) engage behind capability gates
+        # with lax.sort as the oracle; HEAT_TPU_SORT_KERNEL=0 forces the
+        # oracle everywhere.
+        from .. import kernels as _kernels
+
+        values, indices = _kernels.local_sort(
+            a.larray, axis=axis, descending=descending
         )
         vals = _wrap(values, a.split, a, dtype=a.dtype)
         idx = _wrap(indices.astype(types.index_jax_type()), a.split, a)

@@ -34,6 +34,7 @@ from ._jax_compat import pcast, shard_map
 from typing import Callable, Optional, Tuple
 
 from . import types
+from ..kernels.sort import block_sort as _local_block_sort, _mode as _sort_kernel_mode
 
 __all__ = ["halo_exchange", "ring_pairwise", "distributed_sort", "distributed_topk"]
 
@@ -233,7 +234,7 @@ _METRICS = {
 # distributed sort                                                       #
 # ---------------------------------------------------------------------- #
 @functools.lru_cache(maxsize=64)
-def _oddeven_sort_values_program(mesh: Mesh, axis_name: str, ndim: int, split: int):
+def _oddeven_sort_values_program(mesh: Mesh, axis_name: str, ndim: int, split: int, sort_impl: str = "0"):
     """Values-only variant of the odd-even sort: no index operand rides the
     ``ppermute``s, halving per-round collective volume (the hot
     percentile/median path needs only sorted values). Tie consistency
@@ -246,7 +247,7 @@ def _oddeven_sort_values_program(mesh: Mesh, axis_name: str, ndim: int, split: i
     def body(v):
         r = lax.axis_index(axis_name)
         B = v.shape[split]
-        (v,) = lax.sort((v,), dimension=split, is_stable=True)
+        (v,) = _local_block_sort((v,), dimension=split, num_keys=1, is_stable=True, impl=sort_impl)
         for t in range(p):
             start = t % 2
             pairs = [(a, a + 1) for a in range(start, p - 1, 2)]
@@ -259,10 +260,12 @@ def _oddeven_sort_values_program(mesh: Mesh, axis_name: str, ndim: int, split: i
             is_low = in_pair & (((r - start) % 2) == 0)
             a_blk = jnp.where(is_low, v, pv)
             b_blk = jnp.where(is_low, pv, v)
-            (mv,) = lax.sort(
+            (mv,) = _local_block_sort(
                 (jnp.concatenate([a_blk, b_blk], axis=split),),
                 dimension=split,
+                num_keys=1,
                 is_stable=True,
+                impl=sort_impl,
             )
             lo = lax.slice_in_dim(mv, 0, B, axis=split)
             hi = lax.slice_in_dim(mv, B, 2 * B, axis=split)
@@ -274,7 +277,7 @@ def _oddeven_sort_values_program(mesh: Mesh, axis_name: str, ndim: int, split: i
 
 
 @functools.lru_cache(maxsize=64)
-def _oddeven_sort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx_dtype: str):
+def _oddeven_sort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx_dtype: str, sort_impl: str = "0"):
     """shard_map odd-even block merge-split sort along ``split``.
 
     The reference's distributed sort (manipulations.py:2428) is a
@@ -305,7 +308,7 @@ def _oddeven_sort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx
         B = v.shape[split]
         # global position of every local row along the split axis
         i = lax.broadcasted_iota(idt, v.shape, split) + r.astype(idt) * jnp.asarray(B, idt)
-        v, i = lax.sort((v, i), dimension=split, num_keys=2)
+        v, i = _local_block_sort((v, i), dimension=split, num_keys=2, is_stable=False, impl=sort_impl)
         for t in range(p):
             start = t % 2
             pairs = [(a, a + 1) for a in range(start, p - 1, 2)]
@@ -314,10 +317,12 @@ def _oddeven_sort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx
             perm = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
             pv = lax.ppermute(v, axis_name, perm)
             pi = lax.ppermute(i, axis_name, perm)
-            mv, mi = lax.sort(
+            mv, mi = _local_block_sort(
                 (jnp.concatenate([v, pv], axis=split), jnp.concatenate([i, pi], axis=split)),
                 dimension=split,
                 num_keys=2,
+                is_stable=False,
+                impl=sort_impl,
             )
             lo_v = lax.slice_in_dim(mv, 0, B, axis=split)
             hi_v = lax.slice_in_dim(mv, B, 2 * B, axis=split)
@@ -335,7 +340,7 @@ def _oddeven_sort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx
 
 
 @functools.lru_cache(maxsize=64)
-def _columnsort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx_dtype: Optional[str]):
+def _columnsort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx_dtype: Optional[str], sort_impl: str = "0"):
     """Leighton columnsort along ``split``: the O(1)-collective-round
     distributed sort (VERDICT r4 #2 — replaces the O(p)-round odd-even
     schedule at scale).
@@ -383,7 +388,7 @@ def _columnsort_program(mesh: Mesh, axis_name: str, ndim: int, split: int, idx_d
             arrs.append(gi)
 
         def srt(ts):
-            return list(lax.sort(tuple(ts), dimension=0, is_stable=True, num_keys=nk))
+            return list(_local_block_sort(tuple(ts), dimension=0, num_keys=nk, is_stable=True, impl=sort_impl))
 
         def deal(ts):
             out = []
@@ -469,12 +474,18 @@ def distributed_sort(
         idx_dtype = None if not with_indices else (
             "int32" if phys.shape[split] < 2**31 else "int64"
         )
-        prog = _columnsort_program(mesh, axis_name, phys.ndim, split, idx_dtype)
+        prog = _columnsort_program(
+            mesh, axis_name, phys.ndim, split, idx_dtype, _sort_kernel_mode()
+        )
         return prog(phys)
     if not with_indices:
-        return _oddeven_sort_values_program(mesh, axis_name, phys.ndim, split)(phys)
+        return _oddeven_sort_values_program(
+            mesh, axis_name, phys.ndim, split, _sort_kernel_mode()
+        )(phys)
     idx_dtype = "int32" if phys.shape[split] < 2**31 else "int64"
-    prog = _oddeven_sort_program(mesh, axis_name, phys.ndim, split, idx_dtype)
+    prog = _oddeven_sort_program(
+        mesh, axis_name, phys.ndim, split, idx_dtype, _sort_kernel_mode()
+    )
     return prog(phys)
 
 

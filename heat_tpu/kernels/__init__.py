@@ -1,0 +1,30 @@
+"""heat_tpu.kernels — hand-tiled single-chip kernels.
+
+The repo's pattern (arXiv:2112.09017, applied to hSVD in
+``core/linalg/_pallas_sketch.py``): a hand-tiled single-chip kernel
+under an UNCHANGED collective schedule is where the throughput lives.
+This package holds the kernels that are not tied to one algorithm
+module — currently the local radix/columnsort sort engines feeding both
+``ht.sort``'s single-chip path and the distributed sort networks'
+local-sort steps (``core/parallel.py``). Every kernel here ships with
+capability gates, a ``lax.*`` numerical oracle as the fallback, and an
+environment escape hatch.
+"""
+
+from . import sort
+from .sort import (
+    block_sort,
+    from_sortable,
+    local_sort,
+    sort_plan,
+    to_sortable,
+)
+
+__all__ = [
+    "sort",
+    "block_sort",
+    "from_sortable",
+    "local_sort",
+    "sort_plan",
+    "to_sortable",
+]

@@ -53,7 +53,19 @@ HIGHER_IS_BETTER = {
     "melem_per_s",
     "speedup_vs_torch_cpu",
     "speedup_vs_torch_svd_lowrank",
+    # sort-row acceptance fields (ISSUE 4): public fused sort vs raw
+    # values-only jnp.sort, and achieved fraction of the dispatched
+    # path's pass-count HBM model (heat_tpu.kernels.sort.sort_plan)
+    "vs_jnp_sort",
+    "sort_frac",
 }
+
+# rows that changed name across rounds: a baseline row under the old
+# name gates against the current row under the new one (PR 4 folded the
+# legacy `reshape` detail row — which still carried the pre-planner
+# 0.084 hbm_frac in old artifacts — into the planner-attributed
+# `reshape_split1_1gb` row; both always measured the same workload)
+ROW_RENAMES = {"reshape": "reshape_split1_1gb"}
 LOWER_IS_BETTER = {
     "seconds",
     "seconds_unrounded",
@@ -102,6 +114,13 @@ def _latest_round_artifact() -> str | None:
 
 def compare(current: dict, baseline: dict, threshold: float) -> dict:
     cur_rows, base_rows = _rows_of(current), _rows_of(baseline)
+    # rename handling: re-key baseline rows whose name the bench retired,
+    # unless the baseline already carries the new name too
+    for old, new in ROW_RENAMES.items():
+        if old in base_rows and new not in base_rows:
+            base_rows[new] = base_rows.pop(old)
+        if old in cur_rows and new not in cur_rows:
+            cur_rows[new] = cur_rows.pop(old)
     regressions, improvements, compared = [], [], 0
     # rows only one side knows about never gate: a brand-new benchmark
     # (in BENCH_DETAIL.json but not yet in any BENCH_r*.json artifact)

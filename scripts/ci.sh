@@ -42,6 +42,15 @@ HEAT_TPU_TELEMETRY=1 python -m pytest tests/test_smoke.py tests/test_observabili
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): OK')"
 
+# sort-kernel legs (ISSUE 4): the kernel family FORCED on CPU — the
+# Pallas radix block kernel runs in interpret mode, the XLA radix and
+# blocked-columnsort engines natively — against the lax.sort oracle
+# (leg 8); and the HEAT_TPU_SORT_KERNEL=0 escape hatch over the public
+# sort surface, proving the hatch is oracle-identical (leg 9)
+HEAT_TPU_SORT_KERNEL=1 python -m pytest tests/test_kernels_sort.py -q "$@"
+
+HEAT_TPU_SORT_KERNEL=0 python -m pytest tests/test_manipulations.py tests/test_kernels_sort.py -q -k "sort" "$@"
+
 python scripts/lint.py heat_tpu/
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
