@@ -91,6 +91,12 @@ N_QR = 2000              # benchmarks/cb/linalg.py:55
 HSVD_M, HSVD_N, HSVD_R = 16384, 2048, 10   # torch-comparable baseline workload
 KM_N, KM_D, KM_K = 1_048_576, 64, 8        # KMeans iter/s at scale
 RESHAPE_SHAPE = (1000, 250_000)            # cb uses 1000x10M..40M on a cluster
+# lane-friendly reshape companion (ISSUE 5): 1.07 GB with minor dims
+# >= 128 END TO END (512-/256-lane shards over p=8), so no pivot stage
+# pays lane amplification — the row that shows what the repartition
+# machinery does when layout is not the bottleneck
+LANE_SHAPE = (65536, 4096)
+LANE_OUT = (131072, 2048)
 CONCAT_SIZES = (10_000, 20_000, 40_000)    # benchmarks/cb/manipulations.py:20
 SUM_N = 100_000_000
 SORT_N = 16_777_216                        # distributed sort (values+indices)
@@ -172,6 +178,33 @@ def _loop_program_time(make_looped, args, sync, k1, k2, reps=7) -> float:
         t2 = time.perf_counter()
         est.append(((t2 - t1) - (t1 - t0)) / (k2 - k1))
     return max(statistics.median(est), 1e-9)
+
+
+def _loop_program_group(members, sync, k1, k2, reps=7):
+    """``_loop_program_time`` for a GROUP of directly-compared
+    loop-carried bodies, interleaved within the same rep loop so every
+    member sees the same tunnel weather (ISSUE 5: ``vs_splash_row``
+    must be computed from same-run samples — two independently-measured
+    rows can drift ±20% apart on weather alone and fabricate a ratio).
+
+    ``members``: {name: (make_looped, args)} with ``make_looped(k) ->
+    jitted fn(*args)`` exactly as for ``_loop_program_time``."""
+    fns = {name: (make(k1), make(k2)) for name, (make, _args) in members.items()}
+    for name, (_make, args) in members.items():
+        f1, f2 = fns[name]
+        sync(f1(*args))  # compile + warm both loop lengths
+        sync(f2(*args))
+    ests = {name: [] for name in members}
+    for _ in range(reps):
+        for name, (_make, args) in members.items():
+            f1, f2 = fns[name]
+            t0 = time.perf_counter()
+            sync(f1(*args))
+            t1 = time.perf_counter()
+            sync(f2(*args))
+            t2 = time.perf_counter()
+            ests[name].append(((t2 - t1) - (t1 - t0)) / (k2 - k1))
+    return {k: max(statistics.median(v), 1e-9) for k, v in ests.items()}
 
 
 def _measure_bounded(thunk, floor_seconds, retries=2):
@@ -735,6 +768,30 @@ def measure_heat_tpu() -> dict:
         out["_reshape_plan"] = {}
     del r
 
+    # reshape_lane_1gb: the lane-friendly companion — same planner-routed
+    # pivot machinery, minor dims >= 128 on every stage, so its hbm_frac
+    # reads the machinery's own ceiling rather than the lane cap
+    rl = ht.zeros(LANE_SHAPE, split=1)
+    lane_bytes = LANE_SHAPE[0] * LANE_SHAPE[1] * 4
+    lane_floor = 2 * lane_bytes / max(len(jax.devices()), 1) / V5E_HBM_BPS
+    out["reshape_lane_1gb"] = _measure_bounded(
+        lambda: _chained_slope(
+            rl,
+            lambda y: ht.reshape(ht.reshape(y, LANE_OUT, new_split=1),
+                                 LANE_SHAPE, new_split=1),
+            sync, k1=2, k2=10,
+        ) / 2,
+        lane_floor,
+    )
+    _progress("reshape_lane_1gb", out["reshape_lane_1gb"])
+    method["reshape_lane_1gb"] = "chained-slope (pair, halved; planner-routed lane-friendly companion)"
+    try:
+        plan = ht.redistribution.explain(rl, reshape=LANE_OUT, new_split=1)
+        out["_reshape_lane_plan"] = {"strategy": plan.strategy, "plan_id": plan.plan_id}
+    except Exception:
+        out["_reshape_lane_plan"] = {}
+    del rl
+
     # resplit_1gb: split 0 -> 1 -> 0, one planned all-to-all per direction
     rsp = ht.zeros(RESHAPE_SHAPE, split=0)
     out["resplit_1gb"] = _measure_bounded(
@@ -868,8 +925,8 @@ def measure_heat_tpu() -> dict:
     kern_run = _splash_callable(ra_shape, ra_shape, True, ra_scale, "bfloat16")
     ra_floor = RAB_B * RAB_H * 2 * 2 * RAB_S * RAB_S * RAB_D * 0.5 / V5E_BF16_FLOPS
 
-    def _attn_loop_row(fn3):
-        """Loop-program slope of an attention callable fn3(q, k, v) —
+    def _attn_make(fn3):
+        """make_looped factory for an attention callable fn3(q, k, v) —
         shared by the bare-splash row and the kernel-ring row so their
         digest/loop logic cannot diverge."""
         kb, vb = qkv_big[1]._phys, qkv_big[2]._phys
@@ -880,33 +937,25 @@ def measure_heat_tpu() -> dict:
                 return fn3(y, kb, vb).astype(y.dtype)
             return jax.jit(lambda y: lax.fori_loop(0, k, body, y))
 
+        return make
+
+    def _attn_loop_row(fn3):
         return _measure_bounded(
-            lambda: _loop_program_time(make, (qkv_big[0]._phys,), sync, k1=4, k2=44),
+            lambda: _loop_program_time(_attn_make(fn3), (qkv_big[0]._phys,), sync, k1=4, k2=44),
             ra_floor,
         )
-
-    measured = False
-    if kern_run is not None:
-        try:
-            out["ring_attention_16k_bf16"] = _attn_loop_row(kern_run)
-            method["ring_attention_16k_bf16"] = "loop-program (splash kernel)"
-            measured = True
-        except Exception:
-            pass
-    if not measured:  # non-TPU or kernel unavailable: public chained path
-        out["ring_attention_16k_bf16"] = _chained_slope(
-            qkv_big[0],
-            lambda y: ht.nn.ring_attention(y, qkv_big[1], qkv_big[2], causal=True),
-            sync, k1=4, k2=28, reps=5,
-        )
-        method["ring_attention_16k_bf16"] = "chained-slope (public path)"
-    _progress("ring_attention_16k_bf16", out["ring_attention_16k_bf16"])
 
     # VERDICT r4 #1 done-criterion: the KERNEL RING program on a 1-chip
     # mesh must sit within ~10% of the bare splash row — proving the ring
     # wrapper (shard_map + scan + causal switch + lse combine) costs
-    # nothing, so the multi-chip path keeps kernel-level MFU per step
-    if measured:
+    # nothing, so the multi-chip path keeps kernel-level MFU per step.
+    # ISSUE 5: both rows are measured as ONE interleaved group with the
+    # matmul-grade floor/retry machinery, so `vs_splash_row` is computed
+    # from same-run samples — two independently-measured rows drift ±20%
+    # on tunnel weather alone, which is how a ring "faster than its
+    # inner splash kernel" used to pass by luck.
+    measured = False
+    if kern_run is not None:
         from heat_tpu.nn.attention import _ring_attention_kernel_callable
         from jax.sharding import Mesh as _Mesh1
 
@@ -917,11 +966,39 @@ def measure_heat_tpu() -> dict:
         )
         if ring1 is not None:
             try:
-                out["ring_kernel_p1_16k"] = _attn_loop_row(ring1)
-                method["ring_kernel_p1_16k"] = "loop-program (kernel ring, 1-chip mesh)"
+                grp = _measure_bounded_group(
+                    lambda: _loop_program_group(
+                        {
+                            "splash": (_attn_make(kern_run), (qkv_big[0]._phys,)),
+                            "ring": (_attn_make(ring1), (qkv_big[0]._phys,)),
+                        },
+                        sync, k1=4, k2=44,
+                    ),
+                    {"splash": ra_floor, "ring": ra_floor},
+                )
+                out["ring_attention_16k_bf16"] = grp["splash"]
+                out["ring_kernel_p1_16k"] = grp["ring"]
+                method["ring_attention_16k_bf16"] = "loop-program (splash kernel; interleaved group)"
+                method["ring_kernel_p1_16k"] = "loop-program (kernel ring, 1-chip mesh; interleaved group)"
                 _progress("ring_kernel_p1_16k", out["ring_kernel_p1_16k"])
+                measured = True
             except Exception:
                 pass
+        if not measured:
+            try:  # ring wrapper unavailable: bare splash row alone
+                out["ring_attention_16k_bf16"] = _attn_loop_row(kern_run)
+                method["ring_attention_16k_bf16"] = "loop-program (splash kernel)"
+                measured = True
+            except Exception:
+                pass
+    if not measured:  # non-TPU or kernel unavailable: public chained path
+        out["ring_attention_16k_bf16"] = _chained_slope(
+            qkv_big[0],
+            lambda y: ht.nn.ring_attention(y, qkv_big[1], qkv_big[2], causal=True),
+            sync, k1=4, k2=28, reps=5,
+        )
+        method["ring_attention_16k_bf16"] = "chained-slope (public path)"
+    _progress("ring_attention_16k_bf16", out["ring_attention_16k_bf16"])
     del qkv_big
 
     # headline: hsvd_rank at the north-star per-chip shard (2.1 GB), the
@@ -1187,6 +1264,21 @@ def main() -> None:
             hbm(k, rs_bytes)
     if "reshape_split1_1gb" in detail:
         detail["reshape_split1_1gb"].update(ours.get("_reshape_plan", {}))
+        if "strategy" in detail["reshape_split1_1gb"]:
+            # `path` mirrors the sort rows' field: the dispatched route
+            # the number is attributable to (packed-pivot = the
+            # lane-packing relayout engine, heat_tpu.kernels.relayout)
+            detail["reshape_split1_1gb"]["path"] = detail["reshape_split1_1gb"]["strategy"]
+    # lane-friendly companion (ISSUE 5): minor dims >= 128 end to end —
+    # its hbm_frac is the repartition machinery's own ceiling, next to
+    # the lane-capped row it contextualizes
+    if "reshape_lane_1gb" in detail:
+        lane_pair_bytes = 2 * LANE_SHAPE[0] * LANE_SHAPE[1] * 4
+        detail["reshape_lane_1gb"]["bytes_moved"] = lane_pair_bytes
+        hbm("reshape_lane_1gb", lane_pair_bytes)
+        detail["reshape_lane_1gb"].update(ours.get("_reshape_lane_plan", {}))
+        if "strategy" in detail["reshape_lane_1gb"]:
+            detail["reshape_lane_1gb"]["path"] = detail["reshape_lane_1gb"]["strategy"]
 
     # chip rows
     mfu("matmul_bf16_8k", 2 * MM_8K**3)
@@ -1386,6 +1478,13 @@ def main() -> None:
                 if "kmeans_iter_4gb" in detail else {}
             ),
             "sort_1gb": pick("sort_1gb", "melem_per_s", "vs_jnp_sort", "sort_frac", "path"),
+            # the ROADMAP reshape acceptance fields (ISSUE 5): both rows
+            # in the driver artifact so future rounds gate on them
+            "reshape_split1_1gb": pick("reshape_split1_1gb", "hbm_frac", "path", "measurement_suspect"),
+            "reshape_lane_1gb": (
+                pick("reshape_lane_1gb", "hbm_frac", "path", "measurement_suspect")
+                if "reshape_lane_1gb" in detail else {}
+            ),
             "op_chain": pick("op_chain", "overhead_vs_raw_jnp", "overhead_vs_fused_jnp"),
             "ht_jit_chain": pick("ht_jit_chain", "overhead_vs_fused_jnp") if "ht_jit_chain" in detail else {},
             "kmeans_fit_cb": pick("kmeans_fit_cb", "seconds", "speedup_vs_torch_cpu"),

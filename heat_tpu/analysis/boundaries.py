@@ -111,6 +111,16 @@ HOST_BOUNDARIES: Dict[str, Tuple[str, str, str]] = {
         "a no-op over the remote tunnel — bench.py methodology). Runs "
         "only eagerly on TPU, never inside a trace",
     ),
+    "relayout-autotune-sync": (
+        "kernels/relayout.py",
+        "_sync_scalar",
+        "the relayout-kernel autotuner times the XLA pack/unpack "
+        "formulation against the Pallas tiled-copy kernel ONCE per shape "
+        "signature and caches the winner (XLA is the floor); the scalar "
+        "read-back is the completion fence per timed probe. Runs only "
+        "eagerly on TPU at executor program-BUILD time, never inside a "
+        "trace",
+    ),
 }
 
 

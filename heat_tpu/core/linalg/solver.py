@@ -100,30 +100,35 @@ def _lanczos_program(n: int, m: int, jdtype: str, breakdown_tol: float):
     random direction (reference draws a random vector on breakdown)."""
     tol = breakdown_tol
 
+    # inner products are CONJUGATED (x^H y) so the same program is the
+    # hermitian-Lanczos on native complex inputs (CPU/GPU worlds); on
+    # real dtypes conj is the identity and the recursion is unchanged.
+    # Norms take .real — v^H v is real by construction, and the sqrt
+    # must not promote through a complex dtype.
     def run(A, v0, key):
         V0 = jnp.zeros((n, m), dtype=jdtype).at[:, 0].set(v0)
         w0 = A @ v0
-        a0 = w0 @ v0
+        a0 = jnp.conj(v0) @ w0
         w0 = w0 - a0 * v0
         alpha0 = jnp.zeros((m,), dtype=jdtype).at[0].set(a0)
         beta0 = jnp.zeros((m,), dtype=jdtype)
 
         def step(carry, i):
             V, w, alpha, beta = carry
-            b_i = jnp.sqrt(w @ w)
+            b_i = jnp.sqrt((jnp.conj(w) @ w).real)
             invariant = b_i < tol
             # normal candidate (safe divide) vs random restart direction
-            vi = jnp.where(invariant, jax.random.normal(jax.random.fold_in(key, i), (n,), dtype=jdtype), w / jnp.where(invariant, 1.0, b_i))
+            vi = jnp.where(invariant, jax.random.normal(jax.random.fold_in(key, i), (n,), dtype=jdtype), w / jnp.where(invariant, 1.0, b_i).astype(jdtype))
             # full reorthogonalization against columns < i (masked)
-            proj = V.T @ vi
+            proj = jnp.conj(V).T @ vi
             proj = jnp.where(jnp.arange(m) < i, proj, 0.0)
             vi = vi - V @ proj
-            vi = vi / jnp.sqrt(vi @ vi)
+            vi = vi / jnp.sqrt((jnp.conj(vi) @ vi).real).astype(jdtype)
             V = lax.dynamic_update_slice_in_dim(V, vi[:, None], i, axis=1)
             w = A @ vi
-            a_i = w @ vi
+            a_i = jnp.conj(vi) @ w
             v_prev = lax.dynamic_slice_in_dim(V, i - 1, 1, axis=1)[:, 0]
-            w = w - a_i * vi - b_i * v_prev
+            w = w - a_i * vi - b_i.astype(jdtype) * v_prev
             alpha = alpha.at[i].set(a_i)
             beta = beta.at[i].set(b_i)
             return (V, w, alpha, beta), None
@@ -186,8 +191,13 @@ def lanczos(
 
     if m == 1:
         w = basics.matmul(A, v0)
-        alpha = np.array([float(basics.matmul(w, v0))])
-        beta = np.zeros(1)
+        # conjugated inner product (v0^H A v0) via the .numpy() host
+        # funnel: native complex inputs keep their (real-valued, but
+        # complex-typed) Rayleigh quotient instead of crashing in
+        # float(); real inputs are numerically unchanged
+        a0 = np.asarray(basics.vdot(v0, w).numpy())
+        alpha = np.array([a0])
+        beta = np.zeros(1, dtype=alpha.real.dtype)
         V_arr = v0.larray[:, None]
         T_np = np.diag(alpha) + np.diag(beta[1:], 1) + np.diag(beta[1:], -1)
         T_arr = None

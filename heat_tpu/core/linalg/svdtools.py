@@ -95,7 +95,9 @@ def _gram_orthonormalize(z):
     Cost: two reads of the SMALL z (m×l) instead of a latency-bound
     Householder sweep."""
     for _ in range(2):
-        gram = jnp.matmul(z.T, z, precision="highest")  # (l, l) PSD
+        # conjugated Gram (z^H z): hermitian PSD for native complex
+        # inputs too (CPU/GPU worlds); conj is the identity on reals
+        gram = jnp.matmul(jnp.conj(z).T, z, precision="highest")  # (l, l) PSD
         lam, v = jnp.linalg.eigh(gram)                  # ascending
         # relative floor for rank deficiency PLUS an absolute one: an
         # all-zero block (max λ = 0) must yield rsqrt(tiny) — finite — so
@@ -119,10 +121,14 @@ def _cholqr2_refine(v):
     eye = jnp.eye(v.shape[1], dtype=v.dtype)
     for _ in range(2):
         # the MXU's default bf16 passes cap orthogonality at ~1e-3; these
-        # (l×l)-contraction matmuls are free at full f32 precision
-        g = jnp.matmul(v.T, v, precision="highest") + jnp.finfo(v.dtype).eps * eye
-        r = jnp.linalg.cholesky(g)  # lower: g = r rᵀ
-        v = jax.scipy.linalg.solve_triangular(r, v.T, lower=True).T
+        # (l×l)-contraction matmuls are free at full f32 precision.
+        # Conjugated forms (v^H v = r r^H, v ← v r^{-H}) so the refine is
+        # the complex Cholesky-QR on native complex inputs — an
+        # unconjugated complex Gram is not hermitian and its Cholesky
+        # NaNs (the pre-PR-5 hsvd split=0 complex failure mode)
+        g = jnp.matmul(jnp.conj(v).T, v, precision="highest") + jnp.finfo(v.dtype).eps * eye
+        r = jnp.linalg.cholesky(g)  # lower: g = r r^H
+        v = jnp.conj(jax.scipy.linalg.solve_triangular(r, jnp.conj(v).T, lower=True)).T
     return v
 
 
@@ -193,9 +199,11 @@ def _sketched_uds_both(a_blk, keep: int, sketch_l: int, want: str = "left"):
         w, norm_sq = fused               # pass 1 + norm in one stream
     else:
         w = g @ a_blk                    # pass 1: (l, n)
-    qw = _gram_orthonormalize(w.T)       # (n, l) — small O(n·l²), no pass
+    # the range basis must span rows of w CONJUGATED (A ≈ A·Q·Q^H needs
+    # Q from the row space of A, i.e. columns of A^H = conj(wᵀ) sketches)
+    qw = _gram_orthonormalize(jnp.conj(w).T)  # (n, l) — small O(n·l²), no pass
     z = jnp.matmul(a_blk, qw)            # pass 2: (m, l) row-space projection
-    gram = jnp.matmul(z.T, z, precision="highest")  # (l, l): λ accuracy
+    gram = jnp.matmul(jnp.conj(z).T, z, precision="highest")  # (l, l): λ accuracy
                                          # sets σ² quality; full f32 is free here
     lam, u_z = jnp.linalg.eigh(gram)     # ascending
     lam = jnp.maximum(lam[::-1], 0.0)    # descending energies σ²
@@ -215,7 +223,8 @@ def _sketched_uds_both(a_blk, keep: int, sketch_l: int, want: str = "left"):
         # orthonormal·orthogonal — full precision keeps it at machine eps
         v = jnp.matmul(qw, u_z[:, :keep], precision="highest")  # (n, keep)
     if norm_sq is None:
-        norm_sq = jnp.sum(a_blk * a_blk)  # separate norm pass (fallback)
+        # |a|² Frobenius (conj is the identity on reals): separate pass
+        norm_sq = jnp.sum(jnp.real(a_blk * jnp.conj(a_blk)))
     err_sq = jnp.maximum(norm_sq - jnp.sum(lam), 0.0)
     return u, v, s, err_sq, norm_sq
 
@@ -289,17 +298,19 @@ def _one_view_uds_both(a_blk, keep: int, k_hat: int, sketch_l: int, want: str = 
         # XLA fallback/oracle: same algorithm, three reads of A
         w_full = g @ a_blk
         y = a_blk @ omega
-        norm_sq = jnp.sum(a_blk * a_blk)
+        norm_sq = jnp.sum(jnp.real(a_blk * jnp.conj(a_blk)))
     w, w_err = w_full[:sketch_l], w_full[sketch_l:]
     g_err = g[sketch_l:]
     q = _gram_orthonormalize(y)          # (m, k̂) — O(m·k̂²), no pass
     psi_q = jnp.matmul(g[:sketch_l], q, precision="highest")  # (ℓ, k̂)
     qq, rr = jnp.linalg.qr(psi_q)
-    # B = (ΨQ)⁺ W solved through the QR factors (Tropp's stable form)
+    # B = (ΨQ)⁺ W solved through the QR factors (Tropp's stable form);
+    # conjugated adjoints keep the pseudo-inverse and Gram hermitian on
+    # native complex inputs (identity on reals)
     b = jax.scipy.linalg.solve_triangular(
-        rr, jnp.matmul(qq.T, w, precision="highest"), lower=False
+        rr, jnp.matmul(jnp.conj(qq).T, w, precision="highest"), lower=False
     )                                    # (k̂, n)
-    gram = jnp.matmul(b, b.T, precision="highest")
+    gram = jnp.matmul(b, jnp.conj(b).T, precision="highest")
     lam, u_b = jnp.linalg.eigh(gram)
     lam = jnp.maximum(lam[::-1], 0.0)
     u_b = u_b[:, ::-1]
@@ -315,19 +326,19 @@ def _one_view_uds_both(a_blk, keep: int, k_hat: int, sketch_l: int, want: str = 
         u = _cholqr2_refine(u)
     if want in ("right", "both"):
         inv_s = jnp.where(s > 0, 1.0 / s, 0.0)
-        v = jnp.matmul(b.T, u_b[:, :keep], precision="highest") * inv_s
+        v = jnp.matmul(jnp.conj(b).T, u_b[:, :keep], precision="highest") * inv_s
         v = _cholqr2_refine(v)
     # unbiased residual estimate from the held-out sketch rows:
     # Ψ₂A − (Ψ₂Q)B, with the KEPT-rank reconstruction (drop tail modes)
     b_keep = jnp.matmul(
-        u_b[:, :keep].T, b, precision="highest"
+        jnp.conj(u_b[:, :keep]).T, b, precision="highest"
     )                                    # (keep, n) rank-truncated B
     pred = jnp.matmul(
         jnp.matmul(g_err, q, precision="highest") @ u_b[:, :keep],
         b_keep, precision="highest",
     )
     resid = w_err - pred
-    err_sq = jnp.sum(resid * resid) / q_err
+    err_sq = jnp.sum(jnp.real(resid * jnp.conj(resid))) / q_err
     return u, v, s, err_sq, norm_sq
 
 
@@ -765,9 +776,16 @@ def _hsvd_impl(
         # single-device path: factors already in the input orientation
         U_of_A, V_of_A = u_direct, v_direct
     elif transposed:
-        # A = U Σ Vᵀ for the original orientation: swap factors
+        # A = U Σ V^H for the original orientation: the left factors of
+        # Aᵀ are conj(V) (Aᵀ = conj(V) Σ Uᵀ), so native complex inputs
+        # conjugate on the relabel; real inputs swap factors unchanged
         U_of_A = None
-        V_of_A = U_arr
+        if types.heat_type_is_complexfloating(dtype):
+            from .. import complex_math as _cmath
+
+            V_of_A = _cmath.conj(U_arr)
+        else:
+            V_of_A = U_arr
     else:
         U_of_A = U_arr
         V_of_A = None
@@ -798,7 +816,14 @@ def _postprocess_v(A: DNDarray, factor: DNDarray, sigma: DNDarray, left: bool) -
     if left:
         prod = basics.matmul(A, factor)  # (m, r)
     else:
-        prod = basics.matmul(basics.transpose(A, None), factor)  # (n, r)
+        # V = A^H U / σ: the adjoint, not the transpose — native complex
+        # inputs conjugate (conj is the identity on reals)
+        At = basics.transpose(A, None)
+        if types.heat_type_is_complexfloating(A.dtype):
+            from .. import complex_math as _cmath
+
+            At = _cmath.conj(At)
+        prod = basics.matmul(At, factor)  # (n, r)
     inv_sigma = jnp.where(sigma.larray > 0, 1.0 / sigma.larray, 0.0)
     scaled = prod.larray * inv_sigma
     # A·V·Σ⁻¹ with TRUNCATED (σ, v) pairs is only approximately an

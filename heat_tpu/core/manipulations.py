@@ -445,9 +445,13 @@ def reshape(a: DNDarray, *shape, **kwargs) -> DNDarray:
 
         if _redist.planner_enabled():
             # planner-routed repartition (cost-modeled schedule: split-0
-            # pivot / chunked all-to-all instead of the monolithic
-            # gather); ht.redistribution.explain(a, reshape=shape,
-            # new_split=...) shows the chosen plan
+            # pivot / lane-packed pivot / chunked all-to-all instead of
+            # the monolithic gather — narrow-minor-dim targets run their
+            # relayout copies on packed full-lane buffers via
+            # heat_tpu.kernels.relayout, HEAT_TPU_RELAYOUT_KERNEL
+            # gating the tiled-copy kernel);
+            # ht.redistribution.explain(a, reshape=shape, new_split=...)
+            # shows the chosen plan
             phys = _redist.reshape_phys(
                 a.comm, a._phys, a.gshape, a.split, tuple(shape), new_split
             )

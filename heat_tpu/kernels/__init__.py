@@ -11,7 +11,13 @@ capability gates, a ``lax.*`` numerical oracle as the fallback, and an
 environment escape hatch.
 """
 
+from . import relayout
 from . import sort
+from .relayout import (
+    lane_fill,
+    pack_rows,
+    unpack_rows,
+)
 from .sort import (
     block_sort,
     from_sortable,
@@ -21,10 +27,14 @@ from .sort import (
 )
 
 __all__ = [
+    "relayout",
     "sort",
     "block_sort",
     "from_sortable",
+    "lane_fill",
     "local_sort",
+    "pack_rows",
     "sort_plan",
     "to_sortable",
+    "unpack_rows",
 ]

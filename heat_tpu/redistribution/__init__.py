@@ -10,9 +10,12 @@ reshape repartitions are *planned* —
 - :mod:`~heat_tpu.redistribution.spec` — :class:`RedistSpec`, the
   normalized problem statement and cache key;
 - :mod:`~heat_tpu.redistribution.planner` — the byte/step/peak-memory
-  cost model choosing among direct all-to-all, budget-chunked all-to-all
-  pipelines, the ppermute ring, the split-0-pivot (minor-dim packing)
-  reshape, and the explicit full-all-gather replicate;
+  cost model (with a VREG lane-fill term, ``kernels.relayout``)
+  choosing among direct all-to-all, budget-chunked all-to-all
+  pipelines, the ppermute ring, the split-0-pivot reshape, its
+  lane-packed variant (``packed-pivot`` — narrow-minor stages run on
+  packed full-lane buffers), and the explicit full-all-gather
+  replicate;
 - :mod:`~heat_tpu.redistribution.schedule` — the inspectable,
   golden-testable schedule IR with per-step peak-memory accounting;
 - :mod:`~heat_tpu.redistribution.executor` — lowers schedules to jitted

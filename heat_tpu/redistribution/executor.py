@@ -112,6 +112,40 @@ def _chunked_all_to_all(x, axis_name: str, p: int, split_axis: int, concat_axis:
     return jnp.moveaxis(out, 0, concat_axis)
 
 
+def _packed_flags(sched: Schedule) -> Tuple[bool, bool]:
+    """(packed_in, packed_out) — which pivot stages the plan runs on
+    lane-packed buffers, re-derived from step KINDS around the plan's
+    ``reshape`` step so program and plan cannot disagree."""
+    seen_reshape = False
+    packed_in = packed_out = False
+    for st in sched.steps:
+        if st.kind == "reshape":
+            seen_reshape = True
+        elif st.kind == "unpack" and not seen_reshape:
+            packed_in = True
+        elif st.kind == "pack" and seen_reshape:
+            packed_out = True
+    return packed_in, packed_out
+
+
+def _chunked_a2a_flat(x, axis_name: str, p: int, C: int):
+    """Tiled all-to-all of a ``(p, M)`` column-grouped FLAT buffer
+    (``kernels.relayout.pack_rows`` layout): row d is the block bound
+    for device d; the result's row q is the block received from device
+    q. Both faces are lane-full wide buffers — the packed pivot's
+    collective form. ``C > 1`` pipelines equal column chunks (C | M)."""
+    if C <= 1:
+        return lax.all_to_all(x, axis_name, 0, 0, tiled=True)
+    M = x.shape[1]
+    step = M // C
+    out = jnp.zeros_like(x)
+    for c in range(C):
+        chunk = lax.slice_in_dim(x, c * step, (c + 1) * step, axis=1)
+        r = lax.all_to_all(chunk, axis_name, 0, 0, tiled=True)
+        out = lax.dynamic_update_slice_in_dim(out, r, c * step, axis=1)
+    return out
+
+
 def _ring_exchange(x, axis_name: str, p: int, split_axis: int, concat_axis: int):
     """The same split i->j move as p-1 ppermute hops: at distance d every
     device ships ONE neighbor block, so only 2·(local/p) bytes are in
@@ -227,6 +261,100 @@ def _pivot_program(comm, spec: RedistSpec, budget: int):
     return jax.jit(fn)
 
 
+def _relayout_impls(
+    spec: RedistSpec, sched: Schedule, concrete: bool = True
+) -> Tuple[Optional[str], Optional[str]]:
+    """The (unpack-in, pack-out) kernel implementations serving a
+    packed-pivot plan, decided EAGERLY at program-build time and baked
+    into the program cache key: flipping ``HEAT_TPU_RELAYOUT_KERNEL``
+    rebuilds the program. ``concrete=False`` (the executor is itself
+    being traced, e.g. a reshape under ``ht.jit``) forbids the blocking
+    autotune — the decision falls back to a cached winner or the XLA
+    floor, honoring the ``relayout-autotune-sync`` boundary's
+    never-inside-a-trace contract."""
+    from ..kernels import relayout as _relayout
+
+    packed_in, packed_out = _packed_flags(sched)
+    p = spec.mesh_size
+    (r0, c0), (r1, c1) = spec.gshape, spec.out_shape
+    c0p, c1p = _pad_extent(c0, p), _pad_extent(c1, p)
+    impl_in = (
+        _relayout.decide("unpack", r0 // p, c0p, c0, p, spec.dtype, concrete=concrete)
+        if packed_in
+        else None
+    )
+    impl_out = (
+        _relayout.decide("pack", r1 // p, c1, c1p, p, spec.dtype, concrete=concrete)
+        if packed_out
+        else None
+    )
+    return impl_in, impl_out
+
+
+@functools.lru_cache(maxsize=512)
+def _packed_pivot_program(comm, spec: RedistSpec, budget: int, impl_in, impl_out):
+    """The lane-packing pivot (``packed-pivot``): narrow-minor stages
+    run on (p, rows·cols/p) column-grouped FLAT buffers so the chunked
+    all-to-alls stream full VREGs; the pack/unpack tile-transposing
+    copies are served by ``heat_tpu.kernels.relayout`` (XLA formulation
+    or the Pallas tiled-copy kernel per ``impl_*``), and the only
+    lane-amplified write left is the final dst-shard materialization.
+    Same collective census as the direct pivot."""
+    from ..kernels import relayout as _relayout
+
+    sched = _planner.plan(spec, budget)
+    mesh, axis_name = comm.mesh, comm.axis_name
+    p = spec.mesh_size
+    s, t = spec.src_split, spec.dst_split
+    (r0, c0), (r1, c1) = spec.gshape, spec.out_shape
+    c0p, c1p = _pad_extent(c0, p), _pad_extent(c1, p)
+    R0, R1 = r0 // p, r1 // p
+    cs0, cs1 = c0p // p, c1p // p
+    n_in, n_out = _a2a_chunks(sched)
+    C1, C2 = max(n_in, 1), max(n_out, 1)
+    packed_in, packed_out = _packed_flags(sched)
+
+    def body(xl):
+        if s == 1:
+            if packed_in:
+                grouped = xl.reshape(p, R0 * cs0)  # free row-block grouping
+                recv = _chunked_a2a_flat(grouped, axis_name, p, C1)
+                flat = _relayout.unpack_rows(recv, R0, c0p, c0, p, impl=impl_in)
+            else:
+                y = _chunked_all_to_all(xl, axis_name, p, split_axis=0, concat_axis=1, C=C1)
+                if c0p != c0:
+                    y = lax.slice_in_dim(y, 0, c0, axis=1)
+                flat = y.reshape(R0 * c0)
+        else:  # s == 0: the shard already is a contiguous flat block
+            flat = xl.reshape(-1)
+        if t == 1:
+            if packed_out:
+                grouped = _relayout.pack_rows(flat, R1, c1, c1p, p, impl=impl_out)
+                recv = _chunked_a2a_flat(grouped, axis_name, p, C2)
+                # rows arrive in global order: the reshape IS the single
+                # lane-amplified materialization of the requested layout
+                return recv.reshape(r1, cs1)
+            y = flat.reshape(R1, c1)
+            if c1p != c1:
+                y = jnp.pad(y, ((0, 0), (0, c1p - c1)))
+            return _chunked_all_to_all(y, axis_name, p, split_axis=1, concat_axis=0, C=C2)
+        return flat.reshape(R1, c1)
+
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_axis_spec(axis_name, 2, s),),
+        out_specs=_axis_spec(axis_name, 2, t),
+        check_vma=False,
+    )
+
+    def fn(phys):
+        with _plan_scope(sched.plan_id):
+            return mapped(phys)
+
+    return jax.jit(fn)
+
+
 @functools.lru_cache(maxsize=512)
 def _gather_reshape_program(comm, spec: RedistSpec, budget: int):
     """The explicit fallback: replicate the physical operand (ONE
@@ -278,6 +406,7 @@ def _local_reshape_program(comm, spec: RedistSpec, budget: int):
 def clear_program_cache() -> None:
     _move_program.cache_clear()
     _pivot_program.cache_clear()
+    _packed_pivot_program.cache_clear()
     _gather_reshape_program.cache_clear()
     _local_reshape_program.cache_clear()
 
@@ -288,6 +417,7 @@ from ..core.communication import register_mesh_cache as _register_mesh_cache
 
 _register_mesh_cache(_move_program)
 _register_mesh_cache(_pivot_program)
+_register_mesh_cache(_packed_pivot_program)
 _register_mesh_cache(_gather_reshape_program)
 _register_mesh_cache(_local_reshape_program)
 
@@ -341,7 +471,16 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
     if strategy in ("all-to-all", "chunked-all-to-all", "ring"):
         return _move_program(comm, spec, budget)(phys)
     if strategy == "split0-pivot":
+        if _telemetry._ENABLED:
+            _telemetry.inc("redist.relayout.direct")
         return _pivot_program(comm, spec, budget)(phys)
+    if strategy == "packed-pivot":
+        if _telemetry._ENABLED:
+            _telemetry.inc("redist.relayout.packed")
+        impl_in, impl_out = _relayout_impls(
+            spec, sched, concrete=not isinstance(phys, jax.core.Tracer)
+        )
+        return _packed_pivot_program(comm, spec, budget, impl_in, impl_out)(phys)
     if strategy == "gather-reshape":
         return _gather_reshape_program(comm, spec, budget)(phys)
     if strategy in ("local-reshape", "local"):

@@ -30,6 +30,14 @@ strategy            when / what
                     LOCAL row-major reshape at full lane width,
                     all-to-all out — replaces the full all-gather the
                     split-1 reshape used to compile to
+``packed-pivot``    the same pivot with narrow-minor-dim stages run on
+                    LANE-PACKED buffers (``heat_tpu.kernels.relayout``):
+                    a tile-transposing pack folds rows into the lane
+                    axis so the chunked all-to-alls and relayout copies
+                    stream full VREGs; ONE unpack materializes the
+                    destination's narrow layout (the single
+                    lane-amplified write the requested layout makes
+                    unavoidable)
 ``local-reshape``   reshape whose device blocks stay put (split-0 ↔
                     split-0 divisible, or replicated source): 0
                     collectives
@@ -40,12 +48,19 @@ strategy            when / what
 
 Cost model: a collective step costs ``ALPHA_BYTES + bytes_moved``
 (latency expressed in byte-equivalents, so step count and volume share
-one unit). Among candidates whose per-step transient peak fits the
+one unit), a local relayout copy costs its ``bytes_copied``, and BOTH
+are divided by the step's ``lane_fill`` — the fraction of VREG lanes
+the step's buffer layout fills (``kernels.relayout.lane_fill``,
+``minor_dim/128`` below one tile). 1/lane_fill is the HBM amplification
+a copy through a narrow tiled layout pays on TPU; the term is what
+makes ``packed-pivot`` (one amplified write) beat ``split0-pivot``
+(every stage amplified) exactly on the narrow-minor-dim specs. Among
+candidates whose per-step transient peak fits the
 ``HEAT_TPU_REDIST_BUDGET_MB`` budget the cheapest wins; when nothing
 fits, the smallest peak wins (ring is that floor for split moves).
-Local copy steps (pad/slice/reshape) are bounded by one shard and are
-accounted but not chunkable — the budget must be at least one
-destination shard.
+Local copy steps (pad/slice/reshape/pack/unpack) are bounded by one
+shard and are accounted but not chunkable — the budget must be at
+least one destination shard.
 
 Plans are cached per ``(spec, budget)`` and feed the PR-1 telemetry
 registry: ``redist.plan_cache.{hit,miss}``, ``redist.planned_bytes``,
@@ -155,20 +170,74 @@ def _local_move_bytes(spec: RedistSpec) -> int:
 
 
 # --------------------------------------------------------------------- #
+# lane geometry (the kernels.relayout cost term)                         #
+# --------------------------------------------------------------------- #
+def _fill(minor: int) -> float:
+    from ..kernels import relayout as _relayout
+
+    return _relayout.lane_fill(minor)
+
+
+def _pack_threshold() -> float:
+    from ..kernels import relayout as _relayout
+
+    return _relayout.PACK_FILL_THRESHOLD
+
+
+def _shard_minor(shape, split: Optional[int], p: int) -> int:
+    """Minor-dim extent of the local shard of (shape, split)."""
+    if not shape:
+        return 1
+    loc = [int(v) for v in shape]
+    if split is not None:
+        loc[split] = _pad_extent(loc[split], p) // p
+    return max(loc[-1], 1)
+
+
+def _exchange_fill(shape, i: int, j: int, p: int) -> float:
+    """Worst lane fill among the buffers a split i<->j exchange of
+    ``shape`` touches (pre-exchange: split i, axis j padded;
+    post-exchange: split j, axis i still padded)."""
+
+    def minor_of(split):
+        loc = [int(v) for v in shape]
+        loc[i] = _pad_extent(loc[i], p)
+        loc[j] = _pad_extent(loc[j], p)
+        loc[split] //= p
+        return max(loc[-1], 1)
+
+    return min(_fill(minor_of(i)), _fill(minor_of(j)))
+
+
+# --------------------------------------------------------------------- #
 # candidate builders                                                    #
 # --------------------------------------------------------------------- #
 def _a2a_chunk_steps(
-    L: int, p: int, C: int, what: str, pad_step: Optional[Step], tail_slice: Optional[Step]
+    L: int,
+    p: int,
+    C: int,
+    what: str,
+    pad_step: Optional[Step],
+    tail_slice: Optional[Step],
+    lane_fill: float = 1.0,
 ) -> List[Step]:
     """C laps of slice -> all-to-all, then a scatter reassembly (written
-    in place into the destination buffer: no transient)."""
+    in place into the destination buffer: no transient). ``lane_fill``
+    annotates the collective steps with the VREG fill of the buffers
+    they stream (1.0 = full lanes, the packed forms)."""
     steps: List[Step] = []
     if pad_step is not None:
         steps.append(pad_step)
     crossing = L * (p - 1) // p  # the diagonal block stays home
     if C <= 1:
         steps.append(
-            Step("all_to_all", bytes_moved=crossing, peak_bytes=2 * L, detail=what)
+            Step(
+                "all_to_all",
+                bytes_moved=crossing,
+                peak_bytes=2 * L,
+                detail=what,
+                lane_fill=lane_fill,
+            )
         )
     else:
         for c in range(C):
@@ -182,9 +251,10 @@ def _a2a_chunk_steps(
                     peak_bytes=2 * L // C,
                     detail=what,
                     chunk=c,
+                    lane_fill=lane_fill,
                 )
             )
-        steps.append(Step("pack", peak_bytes=0, detail="scatter chunks into dst shard"))
+        steps.append(Step("concat", peak_bytes=0, detail="scatter chunks into dst shard"))
     if tail_slice is not None:
         steps.append(tail_slice)
     return steps
@@ -214,10 +284,11 @@ def _resplit_candidates(spec: RedistSpec, budget: int) -> List[Schedule]:
     C = _divisor_chunks(concat_extent, needed)
 
     what = f"split {i}->{j}"
+    fill = _exchange_fill(spec.gshape, i, j, p)
     a2a = Schedule(
         spec,
         "all-to-all" if C <= 1 else "chunked-all-to-all",
-        _a2a_chunk_steps(L, p, C, what, pad_step, tail),
+        _a2a_chunk_steps(L, p, C, what, pad_step, tail, lane_fill=fill),
         budget,
         notes=f"C={C} chunks over local axis-{i} extent {concat_extent}" if C > 1 else "",
     )
@@ -233,6 +304,7 @@ def _resplit_candidates(spec: RedistSpec, budget: int) -> List[Schedule]:
                 bytes_moved=blk,
                 peak_bytes=2 * blk,
                 detail=f"hop distance {d}: neighbor block of {what}",
+                lane_fill=fill,
             )
         )
     if tail is not None:
@@ -279,7 +351,10 @@ def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
         C1 = _divisor_chunks(
             _pad_extent(spec.gshape[s], p) // p, -(-2 * L1 // budget)
         )
-        steps += _a2a_chunk_steps(L1, p, C1, f"split {s}->0 (pivot in)", None, None)
+        steps += _a2a_chunk_steps(
+            L1, p, C1, f"split {s}->0 (pivot in)", None, None,
+            lane_fill=_exchange_fill(spec.gshape, s, 0, p),
+        )
         n_coll += C1
         if _pad_extent(spec.gshape[s], p) != spec.gshape[s]:
             steps.append(
@@ -289,6 +364,11 @@ def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
         Step(
             "reshape",
             peak_bytes=shard,
+            bytes_copied=shard,
+            lane_fill=min(
+                _fill(spec.gshape[-1] if spec.gshape else 1),
+                _fill(spec.out_shape[-1] if spec.out_shape else 1),
+            ),
             detail="local row-major reshape at full minor-dim width",
         )
     )
@@ -298,15 +378,21 @@ def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
             [_pad_extent(d, p) if ax == t else d for ax, d in enumerate(spec.out_shape)]
         ) // p * item
         if out_tp != out_t:
+            pad_minor = out_tp if t == len(spec.out_shape) - 1 else spec.out_shape[-1]
             steps.append(
                 Step(
                     "pad",
                     peak_bytes=L2,
+                    bytes_copied=L2,
+                    lane_fill=_fill(pad_minor),
                     detail=f"pad axis {t} {out_t}->{out_tp} (local)",
                 )
             )
         C2 = _divisor_chunks(spec.out_shape[0] // p, -(-2 * L2 // budget))
-        steps += _a2a_chunk_steps(L2, p, C2, f"split 0->{t} (pivot out)", None, None)
+        steps += _a2a_chunk_steps(
+            L2, p, C2, f"split 0->{t} (pivot out)", None, None,
+            lane_fill=_exchange_fill(spec.out_shape, 0, t, p),
+        )
         n_coll += C2
     strategy = "split0-pivot" if n_coll else "local-reshape"
     return Schedule(
@@ -318,6 +404,137 @@ def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
     )
 
 
+def _packed_sides(spec: RedistSpec) -> Tuple[bool, bool]:
+    """(packed_in, packed_out): which pivot stages engage the
+    lane-packed form — 2-D pivots whose shard minor dim fills less than
+    ``kernels.relayout.PACK_FILL_THRESHOLD`` of the lane axis."""
+    p = spec.mesh_size
+    if (
+        not spec.is_reshape
+        or len(spec.gshape) != 2
+        or len(spec.out_shape) != 2
+        or not _pivot_valid(spec)
+    ):
+        return False, False
+    thr = _pack_threshold()
+    s, t = spec.src_split, spec.dst_split
+    packed_in = s == 1 and _fill(_pad_extent(spec.gshape[1], p) // p) < thr
+    packed_out = t == 1 and _fill(_pad_extent(spec.out_shape[1], p) // p) < thr
+    return packed_in, packed_out
+
+
+def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
+    """The split-0 pivot with its narrow-minor stages rewritten on
+    lane-packed buffers (``heat_tpu.kernels.relayout``): the chunked
+    all-to-alls stream (p, rows·cols/p) column-grouped FLAT buffers
+    (full VREGs), and the only lane-amplified copy left is the single
+    unpack that materializes the destination's requested narrow layout.
+    Same collective census as the direct pivot — the packing changes
+    layouts, never movement."""
+    p = spec.mesh_size
+    item = spec.itemsize
+    s, t = spec.src_split, spec.dst_split
+    (r0, c0), (r1, c1) = spec.gshape, spec.out_shape
+    c0p, c1p = _pad_extent(c0, p), _pad_extent(c1, p)
+    R0, R1 = r0 // p, r1 // p
+    shard = spec.size // p * item
+    packed_in, packed_out = _packed_sides(spec)
+    steps: List[Step] = []
+
+    if s == 1:
+        L1 = r0 * c0p // p * item
+        C1 = _divisor_chunks(c0p // p, -(-2 * L1 // budget))
+        if packed_in:
+            steps += _a2a_chunk_steps(
+                L1, p, C1, "split 1->0 (packed pivot in)", None, None, lane_fill=1.0
+            )
+            steps.append(
+                Step(
+                    "unpack",
+                    bytes_copied=R0 * c0 * item,
+                    peak_bytes=R0 * c0p * item,
+                    lane_fill=1.0,
+                    detail=(
+                        f"lane-unpack: ungroup {p} col-blocks, drop row pad "
+                        f"{c0p}->{c0} (kernel-served flat copy)"
+                    ),
+                )
+            )
+        else:
+            steps += _a2a_chunk_steps(
+                L1, p, C1, f"split {s}->0 (pivot in)", None, None,
+                lane_fill=_exchange_fill(spec.gshape, 1, 0, p),
+            )
+            if c0p != c0:
+                steps.append(
+                    Step("slice", peak_bytes=shard, detail="drop axis 1 pad (local)")
+                )
+    steps.append(
+        Step(
+            "reshape",
+            peak_bytes=shard,
+            lane_fill=1.0,
+            detail="flat row-major view of the contiguous split-0 block (no narrow materialization)",
+        )
+    )
+    if t == 1:
+        L2 = r1 * c1p // p * item
+        C2 = _divisor_chunks(R1, -(-2 * L2 // budget))
+        if packed_out:
+            steps.append(
+                Step(
+                    "pack",
+                    bytes_copied=R1 * c1p * item,
+                    peak_bytes=R1 * c1p * item,
+                    lane_fill=1.0,
+                    detail=(
+                        f"lane-pack rows {c1}->{c1p} + group {p} col-blocks for "
+                        "all-to-all (kernel-served flat copy)"
+                    ),
+                )
+            )
+            steps += _a2a_chunk_steps(
+                L2, p, C2, "split 0->1 (packed pivot out)", None, None, lane_fill=1.0
+            )
+            steps.append(
+                Step(
+                    "unpack",
+                    bytes_copied=R1 * c1p * item,
+                    peak_bytes=R1 * c1p * item,
+                    lane_fill=_fill(c1p // p),
+                    detail=(
+                        f"materialize dst shard ({r1}, {c1p // p}) — the single "
+                        "lane-amplified write the requested layout costs"
+                    ),
+                )
+            )
+        else:
+            if c1p != c1:
+                steps.append(
+                    Step(
+                        "pad",
+                        peak_bytes=L2,
+                        bytes_copied=L2,
+                        lane_fill=_fill(c1p),
+                        detail=f"pad axis 1 {c1}->{c1p} (local)",
+                    )
+                )
+            steps += _a2a_chunk_steps(
+                L2, p, C2, f"split 0->{t} (pivot out)", None, None,
+                lane_fill=_exchange_fill(spec.out_shape, 0, 1, p),
+            )
+    return Schedule(
+        spec,
+        "packed-pivot",
+        steps,
+        budget,
+        notes=(
+            "lane-packing pivot: collectives and heavy copies run on packed "
+            "full-lane buffers (HEAT_TPU_RELAYOUT_KERNEL gates the tiled-copy kernel)"
+        ),
+    )
+
+
 def _gather_reshape_schedule(spec: RedistSpec, budget: int) -> Schedule:
     p = spec.mesh_size
     logical = spec.logical_bytes
@@ -326,18 +543,32 @@ def _gather_reshape_schedule(spec: RedistSpec, budget: int) -> Schedule:
             "all_gather",
             bytes_moved=logical * (p - 1) // p,
             peak_bytes=logical,
+            lane_fill=_fill(_shard_minor(spec.gshape, spec.src_split, p)),
             detail="replicate the full operand (fallback: pivot divisibility failed)"
             if spec.is_reshape
             else "explicit replicate",
         )
     ]
     if spec.is_reshape:
-        steps.append(Step("reshape", peak_bytes=logical, detail="replicated reshape"))
+        steps.append(
+            Step(
+                "reshape",
+                peak_bytes=logical,
+                bytes_copied=logical,
+                lane_fill=min(
+                    _fill(spec.gshape[-1] if spec.gshape else 1),
+                    _fill(spec.out_shape[-1] if spec.out_shape else 1),
+                ),
+                detail="replicated reshape",
+            )
+        )
     if spec.dst_split is not None:
         steps.append(
             Step(
                 "slice",
                 peak_bytes=spec.dst_shard_bytes,
+                bytes_copied=spec.dst_shard_bytes,
+                lane_fill=_fill(_shard_minor(spec.out_shape, spec.dst_split, p)),
                 detail=f"slice dst shard (split {spec.dst_split})",
             )
         )
@@ -351,7 +582,13 @@ def _gather_reshape_schedule(spec: RedistSpec, budget: int) -> Schedule:
 
 
 def _cost(s: Schedule) -> int:
-    return sum(ALPHA_BYTES + st.bytes_moved for st in s.steps if st.is_collective)
+    """Byte-equivalent cost: ALPHA per collective launch, plus every
+    step's lane-amplified HBM traffic (payload + local relayout copy
+    writes, divided by the step's VREG lane fill)."""
+    return sum(
+        (ALPHA_BYTES if st.is_collective else 0) + st.effective_bytes
+        for st in s.steps
+    )
 
 
 def _select(candidates: List[Schedule]) -> Schedule:
@@ -403,6 +640,8 @@ def _build(spec: RedistSpec, budget: int) -> Schedule:
         candidates = []
         if _pivot_valid(spec):
             candidates.append(_pivot_schedule(spec, budget))
+            if any(_packed_sides(spec)):
+                candidates.append(_packed_pivot_schedule(spec, budget))
         candidates.append(_gather_reshape_schedule(spec, budget))
         return _select(candidates)
 
@@ -546,5 +785,17 @@ def golden_specs() -> List[Tuple[str, RedistSpec]]:
         (
             "reshape_split1_1gb_p8",
             S((1000, 250000), "float32", 1, 1, 8, reshape_to=(10_000_000, 25)),
+        ),
+        # the reverse of the 1 GB bench move: narrow minor on the SOURCE
+        # side, so the packed pivot engages its lane-unpack stage
+        (
+            "reshape_packed_rev_p8",
+            S((10_000_000, 25), "float32", 1, 1, 8, reshape_to=(1000, 250000)),
+        ),
+        # lane-friendly companion (minor dims >= 128 end to end): the
+        # cost model must keep the DIRECT pivot — packing gains nothing
+        (
+            "reshape_lane_1gb_p8",
+            S((65536, 4096), "float32", 1, 1, 8, reshape_to=(131072, 2048)),
         ),
     ]

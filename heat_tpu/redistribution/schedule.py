@@ -40,7 +40,11 @@ COLLECTIVE_STEP_KINDS: Dict[str, str] = {
     "ppermute": "collective-permute",
 }
 
-_LOCAL_STEP_KINDS = ("slice", "pad", "reshape", "concat", "pack")
+# ``pack``/``unpack`` are the lane-packing relayout copies
+# (heat_tpu.kernels.relayout): pack folds narrow rows into the lane
+# axis so the collective steps run on full-VREG buffers; unpack
+# materializes the destination's narrow layout in ONE copy.
+_LOCAL_STEP_KINDS = ("slice", "pad", "reshape", "concat", "pack", "unpack")
 
 
 class Step:
@@ -49,15 +53,24 @@ class Step:
     Attributes
     ----------
     kind : ``all_to_all`` | ``all_gather`` | ``ppermute`` | ``slice`` |
-        ``pad`` | ``reshape`` | ``concat`` | ``pack``.
+        ``pad`` | ``reshape`` | ``concat`` | ``pack`` | ``unpack``.
     bytes_moved : per-device payload crossing the mesh (collectives;
         0 for local steps).
+    bytes_copied : per-device HBM bytes a LOCAL relayout copy writes
+        (0 for views, collectives, and steps whose copy rides another
+        step's accounting).
     peak_bytes : per-device transient buffer bytes of this step.
+    lane_fill : fraction of VREG lanes the step's dominant buffer
+        layout fills (``kernels.relayout.lane_fill`` of its minor dim);
+        1/lane_fill is the HBM amplification the cost model charges.
     detail : short human-readable description of what the step does.
     chunk : chunk index when the step is one lap of a chunked pipeline.
     """
 
-    __slots__ = ("kind", "bytes_moved", "peak_bytes", "detail", "chunk")
+    __slots__ = (
+        "kind", "bytes_moved", "bytes_copied", "peak_bytes", "lane_fill",
+        "detail", "chunk",
+    )
 
     def __init__(
         self,
@@ -66,12 +79,16 @@ class Step:
         peak_bytes: int = 0,
         detail: str = "",
         chunk: Optional[int] = None,
+        bytes_copied: int = 0,
+        lane_fill: float = 1.0,
     ):
         if kind not in COLLECTIVE_STEP_KINDS and kind not in _LOCAL_STEP_KINDS:
             raise ValueError(f"unknown step kind {kind!r}")
         self.kind = kind
         self.bytes_moved = int(bytes_moved)
+        self.bytes_copied = int(bytes_copied)
         self.peak_bytes = int(peak_bytes)
+        self.lane_fill = float(lane_fill)
         self.detail = detail
         self.chunk = chunk
 
@@ -79,11 +96,19 @@ class Step:
     def is_collective(self) -> bool:
         return self.kind in COLLECTIVE_STEP_KINDS
 
+    @property
+    def effective_bytes(self) -> int:
+        """Lane-amplified HBM traffic the cost model charges this step:
+        (payload + local copy writes) / lane_fill."""
+        return int((self.bytes_moved + self.bytes_copied) / max(self.lane_fill, 1e-9))
+
     def as_dict(self) -> Dict[str, Any]:
         return {
             "kind": self.kind,
             "bytes_moved": self.bytes_moved,
+            "bytes_copied": self.bytes_copied,
             "peak_bytes": self.peak_bytes,
+            "lane_fill": self.lane_fill,
             "detail": self.detail,
             "chunk": self.chunk,
         }
@@ -127,6 +152,17 @@ class Schedule:
         return sum(s.bytes_moved for s in self.steps)
 
     @property
+    def bytes_copied(self) -> int:
+        """Total per-device local relayout copy writes."""
+        return sum(s.bytes_copied for s in self.steps)
+
+    @property
+    def effective_bytes(self) -> int:
+        """Lane-amplified HBM traffic of the whole plan — the volume
+        term of the planner's cost model."""
+        return sum(s.effective_bytes for s in self.steps)
+
+    @property
     def n_steps(self) -> int:
         return len(self.steps)
 
@@ -160,6 +196,7 @@ class Schedule:
             "steps": [s.as_dict() for s in self.steps],
             "peak_bytes": self.peak_bytes,
             "bytes_moved": self.bytes_moved,
+            "bytes_copied": self.bytes_copied,
             "collective_counts": self.collective_counts(),
             "within_budget": self.within_budget,
             "notes": self.notes,

@@ -46,6 +46,11 @@ HIGHER_IS_BETTER = {
     "mfu",
     "tflops",
     "gbps",
+    # `hbm_frac` gates the ROADMAP reshape acceptance fields too
+    # (ISSUE 5): `reshape_split1_1gb.hbm_frac` and the lane-friendly
+    # companion `reshape_lane_1gb.hbm_frac` ride in the compact
+    # key_rows, so driver artifacts carry them round over round (the
+    # string-valued `path`/`strategy` fields are informational)
     "hbm_frac",
     "hbm_frac_algorithmic",
     "iter_per_s",

@@ -51,6 +51,16 @@ HEAT_TPU_SORT_KERNEL=1 python -m pytest tests/test_kernels_sort.py -q "$@"
 
 HEAT_TPU_SORT_KERNEL=0 python -m pytest tests/test_manipulations.py tests/test_kernels_sort.py -q -k "sort" "$@"
 
+# relayout-kernel legs (ISSUE 5), mirroring the sort legs: the
+# lane-packing pack/unpack FORCED onto the Pallas tiled-copy kernel
+# (interpret mode on CPU) under the whole redistribution surface
+# (leg 10); and the HEAT_TPU_RELAYOUT_KERNEL=0 escape hatch, proving
+# the XLA formulation is bit-identical over the packed programs
+# (leg 11)
+HEAT_TPU_RELAYOUT_KERNEL=1 python -m pytest tests/test_kernels_relayout.py tests/test_redistribution.py -q "$@"
+
+HEAT_TPU_RELAYOUT_KERNEL=0 python -m pytest tests/test_kernels_relayout.py -q "$@"
+
 python scripts/lint.py heat_tpu/
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \

@@ -411,6 +411,81 @@ class TestTiling(TestCase):
         self.assertEqual(sum(q_tiles.tile_rows_per_process), q_tiles.tile_rows)
 
 
+class TestComplexNativeLinalg(TestCase):
+    """Native-mode complex linalg (ISSUE 5 satellite): on CPU/GPU worlds
+    complex DNDarrays are native jax complex arrays and the
+    factorizations just work — but nothing asserted it, so a regression
+    would land silently. Pins qr/svd/hsvd_rank/lanczos on complex
+    inputs against their defining identities. (On TPU these ops
+    planar-refuse with an actionable TypeError — the MIGRATING.md
+    "Complex platform policy" table; tests/test_complex_planar.py pins
+    the refusals.)"""
+
+    def _cplx(self, m, n, seed=3):
+        rng = np.random.default_rng(seed)
+        return (
+            rng.standard_normal((m, n)) + 1j * rng.standard_normal((m, n))
+        ).astype(np.complex64)
+
+    def test_qr_complex(self):
+        A = self._cplx(24, 12)
+        for split in (None, 0):
+            x = ht.array(A, split=split)
+            self.assertFalse(x._is_planar)  # native on the CPU mesh
+            q, r = ht.linalg.qr(x)
+            qn, rn = q.numpy(), r.numpy()
+            np.testing.assert_allclose(qn @ rn, A, atol=1e-4)
+            # unitary Q: Q^H Q = I (the complex analog of orthogonality)
+            np.testing.assert_allclose(
+                qn.conj().T @ qn, np.eye(qn.shape[1]), atol=1e-4
+            )
+            # R upper triangular
+            np.testing.assert_allclose(rn, np.triu(rn), atol=1e-5)
+
+    def test_svd_complex(self):
+        A = self._cplx(16, 10)
+        for split in (None, 0):
+            u, s, vh = ht.linalg.svd(ht.array(A, split=split))
+            sn = s.numpy()
+            # singular values real, non-negative, sorted
+            self.assertTrue(np.all(sn >= -1e-6))
+            self.assertTrue(np.all(np.diff(sn) <= 1e-5))
+            np.testing.assert_allclose(
+                sn, np.linalg.svd(A, compute_uv=False), atol=1e-3
+            )
+
+    def test_hsvd_rank_complex(self):
+        # full-rank complex input, rank-3 truncation: the projection
+        # residual must track the optimal truncation error (numpy SVD)
+        A = self._cplx(32, 12, seed=9)
+        x = ht.array(A, split=0)
+        u, err = ht.linalg.hsvd_rank(x, 3)
+        un = u.numpy()
+        self.assertEqual(un.shape, (32, 3))
+        resid = np.linalg.norm(A - un @ (un.conj().T @ A))
+        s = np.linalg.svd(A, compute_uv=False)
+        optimal = np.linalg.norm(s[3:])
+        self.assertLessEqual(resid, 1.5 * optimal + 1e-3)
+
+    def test_lanczos_complex_hermitian(self):
+        A = self._cplx(20, 20, seed=7)
+        H = (A @ A.conj().T).astype(np.complex64)  # hermitian PSD
+        x = ht.array(H, split=0)
+        V, T = ht.linalg.lanczos(x, 8)
+        Vn, Tn = V.numpy(), T.numpy()
+        # V^H V = I and V^H H V = T (the Lanczos relation on the Krylov basis)
+        np.testing.assert_allclose(Vn.conj().T @ Vn, np.eye(8), atol=1e-3)
+        np.testing.assert_allclose(Vn.conj().T @ H @ Vn, Tn, atol=1e-2)
+        # the m=1 shortcut (code-review PR 5): T is the conjugated
+        # Rayleigh quotient v0^H H v0 — real for hermitian H, and it
+        # must not crash converting a complex inner product
+        V1, T1 = ht.linalg.lanczos(x, 1)
+        v1 = V1.numpy()[:, 0]
+        np.testing.assert_allclose(
+            T1.numpy()[0, 0], v1.conj() @ H @ v1, rtol=1e-3
+        )
+
+
 if __name__ == "__main__":
     import unittest
 

@@ -309,7 +309,9 @@ class TestCollectiveCounts(TelemetryCase):
             # gather — same census as the pre-planner baseline
             self.assertEqual(plan.strategy, "gather-reshape")
         else:
-            self.assertEqual(plan.strategy, "split0-pivot")
+            # 40->80 columns over p=8 are 5-/10-lane shards: the
+            # lane-fill cost term engages the packed pivot (PR 5)
+            self.assertEqual(plan.strategy, "packed-pivot")
             self.assertEqual(rep.counts["all-gather"], 0)
             # regression bound: the old monolithic gather assembled every
             # logical byte on every device — the planned schedule must

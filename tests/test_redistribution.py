@@ -50,10 +50,19 @@ GOLDEN_PINS = {
     "mesh1_resplit": ("local", 0, {}),
     "resplit_chunked_2gb_p8": ("chunked-all-to-all", 5, {"all-to-all": 2}),
     "resplit_ring_8gb_p8": ("ring", 7, {"collective-permute": 7}),
-    "reshape_pivot_p8": ("split0-pivot", 3, {"all-to-all": 2}),
+    # narrow minor dims (40->80 over p=8: 5- and 10-lane shards): the
+    # lane-fill cost term picks the packed pivot
+    "reshape_pivot_p8": ("packed-pivot", 6, {"all-to-all": 2}),
     "reshape_split0_local_p8": ("local-reshape", 1, {}),
     "reshape_gather_fallback_p8": ("gather-reshape", 3, {"all-gather": 1}),
-    "reshape_split1_1gb_p8": ("split0-pivot", 8, {"all-to-all": 3}),
+    # the 1 GB ROADMAP spec: packed on the narrow OUT side (25->32 cols,
+    # 4-lane shards); same all-to-all census as the direct pivot
+    "reshape_split1_1gb_p8": ("packed-pivot", 9, {"all-to-all": 3}),
+    # its reverse: packed on the narrow IN side
+    "reshape_packed_rev_p8": ("packed-pivot", 8, {"all-to-all": 3}),
+    # lane-friendly companion (512/256-lane shards): packing gains
+    # nothing, the DIRECT pivot stays
+    "reshape_lane_1gb_p8": ("split0-pivot", 3, {"all-to-all": 2}),
 }
 
 
@@ -71,6 +80,10 @@ def _planner_program(comm, spec, budget):
         return executor._move_program(comm, spec, budget)
     if strategy == "split0-pivot":
         return executor._pivot_program(comm, spec, budget)
+    if strategy == "packed-pivot":
+        sched = planner.plan(spec, budget)
+        impl_in, impl_out = executor._relayout_impls(spec, sched)
+        return executor._packed_pivot_program(comm, spec, budget, impl_in, impl_out)
     if strategy == "gather-reshape":
         return executor._gather_reshape_program(comm, spec, budget)
     return executor._local_reshape_program(comm, spec, budget)
@@ -111,7 +124,7 @@ class TestGoldenPlans(TestCase):
         (spec,) = [s for n, s in _golden() if n == "reshape_split1_1gb_p8"]
         self.assertEqual(spec.logical_bytes, 10**9)
         sched = planner.plan(spec, planner.budget_bytes())
-        self.assertEqual(sched.strategy, "split0-pivot")
+        self.assertEqual(sched.strategy, "packed-pivot")
         for step in sched.steps:
             self.assertLessEqual(step.peak_bytes, planner.budget_bytes())
         self.assertEqual(sched.collective_counts().get("all-gather", 0), 0)
