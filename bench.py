@@ -735,13 +735,72 @@ def measure_heat_tpu() -> dict:
     del Xp
 
     # ------------------------------------------------------------------ #
-    # redistribution-planner rows (ROADMAP `reshape`): the 1 GB planner- #
-    # routed relayouts, measured as there-and-back pairs (halved) with   #
-    # the bytes-based floor/retry machinery — a slope under one read +   #
-    # one write of the per-chip shard at HBM peak is tunnel weather.     #
+    # redistribution-planner rows (ROADMAP `reshape` + ISSUE 6 overlap): #
+    # the 1 GB planner-routed relayouts, measured as there-and-back      #
+    # pairs (halved) with the bytes-based floor/retry machinery — a      #
+    # slope under one read + one write of the per-chip shard at HBM peak #
+    # is tunnel weather. Each row runs as ONE interleaved group with its #
+    # sequential twin (HEAT_TPU_REDIST_OVERLAP=0 vs 1): the same-run     #
+    # samples the PR-5 attention fix demands, so `vs_sequential` is a    #
+    # real ratio, not two weather draws. The headline row is the         #
+    # overlap (shipped-default-on-TPU) member.                           #
     # ------------------------------------------------------------------ #
     redist_bytes = RESHAPE_SHAPE[0] * RESHAPE_SHAPE[1] * 4  # 1 GB operand
     redist_floor = 2 * redist_bytes / max(len(jax.devices()), 1) / V5E_HBM_BPS
+
+    def _gated_step(step, mode):
+        # execute() re-reads HEAT_TPU_REDIST_OVERLAP per call, and the
+        # executor keys its programs on the resolved pipelined flag, so
+        # per-step toggling dispatches the right cached program
+        def run(y):
+            os.environ["HEAT_TPU_REDIST_OVERLAP"] = mode
+            return step(y)
+        return run
+
+    def _overlap_pair(row, init, step, floor):
+        old = os.environ.get("HEAT_TPU_REDIST_OVERLAP")
+        ratios = []  # seq/overlap per GROUP RUN: same-run samples only
+
+        def thunk():
+            res = {
+                k: v / 2
+                for k, v in _chained_slope_group(
+                    {
+                        row: (init, _gated_step(step, "1")),
+                        f"{row}_seq": (init, _gated_step(step, "0")),
+                    },
+                    sync, k1=2, k2=10,
+                ).items()
+            }
+            if res[row] > 1e-9:
+                ratios.append(res[f"{row}_seq"] / res[row])
+            return res
+
+        try:
+            pair = _measure_bounded_group(thunk, {row: floor, f"{row}_seq": floor})
+        finally:
+            if old is None:
+                os.environ.pop("HEAT_TPU_REDIST_OVERLAP", None)
+            else:
+                os.environ["HEAT_TPU_REDIST_OVERLAP"] = old
+        out.update(pair)
+        # the ratio must come from ONE run's pair, not the per-member
+        # maxes a floor retry may have taken from different runs (that
+        # would be exactly the cross-run artifact the interleaved group
+        # exists to kill); median over runs rejects weather
+        if ratios:
+            out[f"_{row}_vs_seq"] = statistics.median(ratios)
+        _progress(row, pair[row])
+        _progress(f"{row}_seq", pair[f"{row}_seq"])
+
+    def _plan_fields(plan):
+        f = {"strategy": plan.strategy, "plan_id": plan.plan_id,
+             "overlap": plan.overlap_depth}
+        if plan.overlap:
+            # the acceptance field: modeled sequential/critical-path
+            # ratio of the pipelined stage groups (max-vs-sum arithmetic)
+            f["critical_path_model"] = plan.overlap["model_speedup"]
+        return f
 
     # reshape there-and-back per step = 2 ops; slope halved. The legacy
     # `reshape` row is FOLDED into the planner-named `reshape_split1_1gb`
@@ -750,20 +809,19 @@ def measure_heat_tpu() -> dict:
     # scripts/bench_compare.py maps baseline `reshape` onto this row).
     # The row self-identifies as planner-routed via strategy/plan_id.
     r = ht.zeros(RESHAPE_SHAPE, split=1)
-    out["reshape_split1_1gb"] = _measure_bounded(
-        lambda: _chained_slope(
-            r,
-            lambda y: ht.reshape(ht.reshape(y, (10_000_000, -1), new_split=1),
-                                 RESHAPE_SHAPE, new_split=1),
-            sync, k1=2, k2=10,
-        ) / 2,
+    _overlap_pair(
+        "reshape_split1_1gb", r,
+        lambda y: ht.reshape(ht.reshape(y, (10_000_000, -1), new_split=1),
+                             RESHAPE_SHAPE, new_split=1),
         redist_floor,
     )
-    _progress("reshape_split1_1gb", out["reshape_split1_1gb"])
-    method["reshape_split1_1gb"] = "chained-slope (pair, halved; planner-routed; folds the legacy `reshape` row)"
+    method["reshape_split1_1gb"] = (
+        "chained-slope (pair, halved; planner-routed; folds the legacy `reshape` row; "
+        "interleaved with the HEAT_TPU_REDIST_OVERLAP=0 sequential twin)"
+    )
     try:
         plan = ht.redistribution.explain(r, reshape=(10_000_000, 25), new_split=1)
-        out["_reshape_plan"] = {"strategy": plan.strategy, "plan_id": plan.plan_id}
+        out["_reshape_plan"] = _plan_fields(plan)
     except Exception:
         out["_reshape_plan"] = {}
     del r
@@ -774,34 +832,34 @@ def measure_heat_tpu() -> dict:
     rl = ht.zeros(LANE_SHAPE, split=1)
     lane_bytes = LANE_SHAPE[0] * LANE_SHAPE[1] * 4
     lane_floor = 2 * lane_bytes / max(len(jax.devices()), 1) / V5E_HBM_BPS
-    out["reshape_lane_1gb"] = _measure_bounded(
-        lambda: _chained_slope(
-            rl,
-            lambda y: ht.reshape(ht.reshape(y, LANE_OUT, new_split=1),
-                                 LANE_SHAPE, new_split=1),
-            sync, k1=2, k2=10,
-        ) / 2,
+    _overlap_pair(
+        "reshape_lane_1gb", rl,
+        lambda y: ht.reshape(ht.reshape(y, LANE_OUT, new_split=1),
+                             LANE_SHAPE, new_split=1),
         lane_floor,
     )
-    _progress("reshape_lane_1gb", out["reshape_lane_1gb"])
-    method["reshape_lane_1gb"] = "chained-slope (pair, halved; planner-routed lane-friendly companion)"
+    method["reshape_lane_1gb"] = (
+        "chained-slope (pair, halved; planner-routed lane-friendly companion; "
+        "interleaved with the sequential twin)"
+    )
     try:
         plan = ht.redistribution.explain(rl, reshape=LANE_OUT, new_split=1)
-        out["_reshape_lane_plan"] = {"strategy": plan.strategy, "plan_id": plan.plan_id}
+        out["_reshape_lane_plan"] = _plan_fields(plan)
     except Exception:
         out["_reshape_lane_plan"] = {}
     del rl
 
-    # resplit_1gb: split 0 -> 1 -> 0, one planned all-to-all per direction
+    # resplit_1gb: split 0 -> 1 -> 0, one planned (chunked, pipelinable)
+    # exchange per direction
     rsp = ht.zeros(RESHAPE_SHAPE, split=0)
-    out["resplit_1gb"] = _measure_bounded(
-        lambda: _chained_slope(
-            rsp, lambda y: y.resplit(1).resplit(0), sync, k1=2, k2=10
-        ) / 2,
-        redist_floor,
+    _overlap_pair(
+        "resplit_1gb", rsp, lambda y: y.resplit(1).resplit(0), redist_floor
     )
-    _progress("resplit_1gb", out["resplit_1gb"])
-    method["resplit_1gb"] = "chained-slope (pair, halved)"
+    method["resplit_1gb"] = "chained-slope (pair, halved; interleaved with the sequential twin)"
+    try:
+        out["_resplit_plan"] = _plan_fields(ht.redistribution.explain(rsp, 1))
+    except Exception:
+        out["_resplit_plan"] = {}
     del rsp
 
     # concatenate + a dependency slice per step = concat op + cheap slice
@@ -1262,13 +1320,6 @@ def main() -> None:
         if k in detail:
             detail[k]["bytes_moved"] = rs_bytes
             hbm(k, rs_bytes)
-    if "reshape_split1_1gb" in detail:
-        detail["reshape_split1_1gb"].update(ours.get("_reshape_plan", {}))
-        if "strategy" in detail["reshape_split1_1gb"]:
-            # `path` mirrors the sort rows' field: the dispatched route
-            # the number is attributable to (packed-pivot = the
-            # lane-packing relayout engine, heat_tpu.kernels.relayout)
-            detail["reshape_split1_1gb"]["path"] = detail["reshape_split1_1gb"]["strategy"]
     # lane-friendly companion (ISSUE 5): minor dims >= 128 end to end —
     # its hbm_frac is the repartition machinery's own ceiling, next to
     # the lane-capped row it contextualizes
@@ -1276,9 +1327,29 @@ def main() -> None:
         lane_pair_bytes = 2 * LANE_SHAPE[0] * LANE_SHAPE[1] * 4
         detail["reshape_lane_1gb"]["bytes_moved"] = lane_pair_bytes
         hbm("reshape_lane_1gb", lane_pair_bytes)
-        detail["reshape_lane_1gb"].update(ours.get("_reshape_lane_plan", {}))
-        if "strategy" in detail["reshape_lane_1gb"]:
-            detail["reshape_lane_1gb"]["path"] = detail["reshape_lane_1gb"]["strategy"]
+    plan_keys = {
+        "resplit_1gb": "_resplit_plan",
+        "reshape_split1_1gb": "_reshape_plan",
+        "reshape_lane_1gb": "_reshape_lane_plan",
+    }
+    for row, pkey in plan_keys.items():
+        if row not in detail:
+            continue
+        detail[row].update(ours.get(pkey, {}))
+        if "strategy" in detail[row]:
+            # `path` mirrors the sort rows' field: the dispatched route
+            # the number is attributable to (packed-pivot = the
+            # lane-packing relayout engine, heat_tpu.kernels.relayout)
+            detail[row]["path"] = detail[row]["strategy"]
+        # ISSUE 6 acceptance fields: `overlap` (pipeline depth from the
+        # plan annotation), `critical_path_model` (the modeled
+        # max-vs-sum speedup, set when the plan pipelines), and the
+        # MEASURED overlap-vs-sequential ratio — median of the
+        # interleaved group's per-run seq/overlap pairs (same-run
+        # samples by construction)
+        ratio = ours.get(f"_{row}_vs_seq")
+        if ratio is not None:
+            detail[row]["vs_sequential"] = round(ratio, 3)
 
     # chip rows
     mfu("matmul_bf16_8k", 2 * MM_8K**3)
@@ -1478,12 +1549,22 @@ def main() -> None:
                 if "kmeans_iter_4gb" in detail else {}
             ),
             "sort_1gb": pick("sort_1gb", "melem_per_s", "vs_jnp_sort", "sort_frac", "path"),
-            # the ROADMAP reshape acceptance fields (ISSUE 5): both rows
-            # in the driver artifact so future rounds gate on them
-            "reshape_split1_1gb": pick("reshape_split1_1gb", "hbm_frac", "path", "measurement_suspect"),
+            # the ROADMAP reshape acceptance fields (ISSUE 5) + the
+            # ISSUE 6 overlap fields (`critical_path_model` = modeled
+            # max-vs-sum speedup, `vs_sequential` = measured same-run
+            # ratio): in the driver artifact so future rounds gate on them
+            "reshape_split1_1gb": pick(
+                "reshape_split1_1gb", "hbm_frac", "path", "critical_path_model",
+                "vs_sequential", "measurement_suspect",
+            ),
             "reshape_lane_1gb": (
-                pick("reshape_lane_1gb", "hbm_frac", "path", "measurement_suspect")
+                pick("reshape_lane_1gb", "hbm_frac", "path", "critical_path_model",
+                     "vs_sequential", "measurement_suspect")
                 if "reshape_lane_1gb" in detail else {}
+            ),
+            "resplit_1gb": pick(
+                "resplit_1gb", "hbm_frac", "path", "critical_path_model",
+                "vs_sequential", "measurement_suspect",
             ),
             "op_chain": pick("op_chain", "overhead_vs_raw_jnp", "overhead_vs_fused_jnp"),
             "ht_jit_chain": pick("ht_jit_chain", "overhead_vs_fused_jnp") if "ht_jit_chain" in detail else {},

@@ -40,8 +40,10 @@ __all__ = [
     "DATA_DEPENDENT_BOUNDARIES",
     "HOST_BOUNDARIES",
     "PLANNER_MODULES",
+    "RING_SCHEDULE_MODULES",
     "is_declared_sync",
     "planned_reshard_plan_id",
+    "ring_schedule_module",
 ]
 
 # modules that are host I/O by contract (posix path suffixes)
@@ -128,28 +130,77 @@ HOST_BOUNDARIES: Dict[str, Tuple[str, str, str]] = {
 # planner-issued reshards (rules SL101/SL102)                             #
 # ---------------------------------------------------------------------- #
 # Modules whose WHOLE PURPOSE is to launch resharding collectives: the
-# redistribution executor compiles the planner's schedules, so its
-# all-to-alls/all-gathers are the budgeted, cost-modeled movement itself,
-# not an accident of operand layout. The IR lint must not flag the
-# subsystem's own programs as implicit reshards — it reports them at
-# info severity with the plan id attached instead.
-PLANNER_MODULES: Tuple[str, ...] = ("redistribution/executor.py",)
+# redistribution executor compiles the planner's schedules (including
+# the software-pipelined chunk loops and ppermute rings of ISSUE 6), and
+# the collective-matmul kernels decompose the linalg all-gathers /
+# reductions into ppermute chains consumed block-by-block — in both, the
+# all-to-alls/all-gathers/collective-permutes ARE the budgeted,
+# cost-modeled movement itself, not an accident of operand layout. The
+# IR lint must not flag the subsystems' own programs as implicit
+# reshards — it reports them at info severity with the stamp attached
+# instead.
+PLANNER_MODULES: Tuple[str, ...] = (
+    "redistribution/executor.py",
+    "kernels/cmatmul.py",
+)
 
-# every executor program runs under jax.named_scope("redist_plan_<id>"),
-# so the plan id lands in the HLO op_name metadata of each collective it
-# launches — the marker the IR lint keys on (12 hex chars: the
-# Schedule.plan_id sha1 prefix)
+# every executor program runs under jax.named_scope("redist_plan_<id>")
+# (12 hex chars: the Schedule.plan_id sha1 prefix) and every
+# collective-matmul ring under jax.named_scope("cmatmul_ring_<tag>"), so
+# the stamp lands in the HLO op_name metadata of each collective the
+# program launches — the markers the IR lint keys on
 _PLAN_MARKER = re.compile(r"redist_plan_([0-9a-f]{12})")
+_CMATMUL_MARKER = re.compile(r"cmatmul_ring_([0-9a-z_]+)")
 
 
 def planned_reshard_plan_id(hlo_line: str) -> Optional[str]:
-    """The redistribution plan id stamped on an HLO instruction line, or
+    """The plan stamp on an HLO instruction line — a redistribution
+    ``plan_id`` or a ``cmatmul:<tag>`` collective-matmul marker — or
     ``None`` when the collective is not planner-issued. ``ircheck`` uses
-    this to downgrade SL101/SL102 findings on planner programs to info
-    severity (with the plan attached) instead of flagging the
-    subsystem's own schedules."""
+    this to downgrade SL101/SL102 findings on stamped programs to info
+    severity (with the stamp attached) instead of flagging the
+    subsystems' own schedules. An UNSTAMPED hand-rolled ppermute loop
+    carries no marker and trips the rule at full severity (golden
+    bad-fixture in ``tests/analysis_fixtures.py``)."""
     m = _PLAN_MARKER.search(hlo_line)
-    return m.group(1) if m else None
+    if m:
+        return m.group(1)
+    m = _CMATMUL_MARKER.search(hlo_line)
+    return f"cmatmul:{m.group(1)}" if m else None
+
+
+# Modules whose ppermute chains are DOCUMENTED ring schedules — the
+# algorithm, not a relayout accident: the distributed sort networks and
+# stencil/halo exchanges (core/parallel.py), the convolution halo
+# exchange (core/signal.py), and ring attention's K/V rotation
+# (nn/attention.py). SL101's collective-permute arm reports their hops
+# at info severity, keyed on the instruction's source_file metadata
+# (these bodies run under shard_map, not a stampable named scope); a
+# hand-rolled ppermute loop anywhere else still trips the rule at full
+# severity. (The other two library ppermute sites —
+# redistribution/executor.py and kernels/cmatmul.py — stamp named
+# scopes instead, see PLANNER_MODULES.)
+RING_SCHEDULE_MODULES: Tuple[str, ...] = (
+    "heat_tpu/core/parallel.py",
+    "heat_tpu/core/signal.py",
+    "heat_tpu/nn/attention.py",
+)
+
+_SOURCE_FILE = re.compile(r'source_file="([^"]+)"')
+
+
+def ring_schedule_module(hlo_line: str) -> Optional[str]:
+    """The blessed ring-schedule module a collective-permute instruction
+    was traced from (its HLO ``source_file`` metadata ends with an entry
+    of :data:`RING_SCHEDULE_MODULES`), or ``None``."""
+    m = _SOURCE_FILE.search(hlo_line)
+    if not m:
+        return None
+    path = _norm(m.group(1))
+    for suffix in RING_SCHEDULE_MODULES:
+        if path.endswith(suffix):
+            return suffix
+    return None
 
 
 def _norm(path: str) -> str:

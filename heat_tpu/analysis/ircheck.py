@@ -15,8 +15,9 @@ are checkable *here*, before any TPU minute is spent. The rules:
 ========  ========  ====================================================
 rule      severity  fires when
 ========  ========  ====================================================
-SL101     warn/err  an all-to-all moves ≥ ``min_bytes`` (err when it
-                    moves ≥ ``replicate_frac`` of the largest input)
+SL101     warn/err  an all-to-all (or a hand-rolled collective-permute
+                    chain hop) moves ≥ ``min_bytes`` (err when it moves
+                    ≥ ``replicate_frac`` of the largest input)
 SL102     warn/err  an all-gather materializes ≥ ``min_bytes`` (same
                     escalation — a full-operand gather is an error)
 SL103     warning   an all-gather result feeds a ``reduce``
@@ -229,7 +230,7 @@ def check(
     context["collective_counts"] = {k: v for k, v in _count_ops(text).items() if v}
 
     # ---- SL101 / SL102: large resharding collectives -------------------
-    from .boundaries import planned_reshard_plan_id
+    from .boundaries import planned_reshard_plan_id, ring_schedule_module
 
     gather_names: List[Tuple[str, int]] = []
     for m in _COLLECTIVE_LINE.finditer(text):
@@ -237,38 +238,67 @@ def check(
         nbytes = _shaped_bytes(result_type)
         if op == "all-gather":
             gather_names.append((ssa, nbytes))
-        if op not in ("all-to-all", "all-gather") or nbytes < min_bytes:
+        if op not in ("all-to-all", "all-gather", "collective-permute") or nbytes < min_bytes:
             continue
-        rule = "SL101" if op == "all-to-all" else "SL102"
+        rule = "SL102" if op == "all-gather" else "SL101"
         # planner-issued reshards (redistribution/executor.py programs run
-        # under jax.named_scope("redist_plan_<id>"), stamping the plan id
-        # into the instruction's op_name metadata) are the budgeted,
-        # cost-modeled movement itself — report them at info severity with
-        # the plan attached instead of flagging the subsystem's own
-        # schedules (see boundaries.PLANNER_MODULES)
+        # under jax.named_scope("redist_plan_<id>") — including ISSUE 6's
+        # software-pipelined ppermute chains — and the collective-matmul
+        # rings under jax.named_scope("cmatmul_ring_<tag>"), stamping the
+        # marker into the instruction's op_name metadata) are the
+        # budgeted, cost-modeled movement itself — report them at info
+        # severity with the stamp attached instead of flagging the
+        # subsystems' own schedules (see boundaries.PLANNER_MODULES)
         line_end = text.find("\n", m.end())
         full_line = text[m.start() : len(text) if line_end == -1 else line_end]
         plan_id = planned_reshard_plan_id(full_line)
         if plan_id is not None:
-            findings.append(
-                Finding(
-                    rule,
-                    "info",
+            if plan_id.startswith("cmatmul:"):
+                msg = (
+                    f"planned collective-matmul movement ({plan_id}): {op} "
+                    f"moves ~{nbytes} B ({ssa}) inside a stamped "
+                    "heat_tpu.kernels.cmatmul ring — the decomposed "
+                    "gather/reduction of the linalg overlap forms "
+                    "(HEAT_TPU_REDIST_OVERLAP)"
+                )
+            else:
+                msg = (
                     f"planned reshard (redist plan {plan_id}): {op} moves "
                     f"~{nbytes} B ({ssa}) under the redistribution "
                     "planner's peak-memory budget — inspect with "
-                    "ht.redistribution.explain",
-                    op=op,
-                    nbytes=nbytes,
+                    "ht.redistribution.explain"
                 )
-            )
+            findings.append(Finding(rule, "info", msg, op=op, nbytes=nbytes))
             continue
+        if op == "collective-permute":
+            # the library's own DOCUMENTED ring schedules (sort
+            # networks, halo exchange, ring attention) rotate blocks by
+            # design — info, keyed on source_file since shard_map bodies
+            # carry no stampable named scope. Hand-rolled loops in user
+            # code still fall through to full severity.
+            blessed = ring_schedule_module(full_line)
+            if blessed is not None:
+                findings.append(
+                    Finding(
+                        rule,
+                        "info",
+                        f"ring schedule ({blessed}): a collective-permute "
+                        f"hop ships ~{nbytes} B ({ssa}) — the documented "
+                        "block rotation of the library's own distributed "
+                        "algorithm, not a relayout accident",
+                        op=op,
+                        nbytes=nbytes,
+                    )
+                )
+                continue
         severity = "error" if nbytes >= err_bytes else "warning"
-        what = (
-            "implicit reshard: an all-to-all relayouts"
-            if op == "all-to-all"
-            else "replicated materialization: an all-gather assembles"
-        )
+        what = {
+            "all-to-all": "implicit reshard: an all-to-all relayouts",
+            "collective-permute": (
+                "implicit reshard: a hand-rolled collective-permute hop ships"
+            ),
+            "all-gather": "replicated materialization: an all-gather assembles",
+        }[op]
         findings.append(
             Finding(
                 rule,

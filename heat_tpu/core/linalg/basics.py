@@ -14,6 +14,8 @@ are preserved as output sharding constraints.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -46,6 +48,63 @@ __all__ = [
     "vecdot",
     "vector_norm",
 ]
+
+
+@functools.lru_cache(maxsize=128)
+def _cmatmul_program(
+    mesh, axis_name: str, m: int, lk: int, n: int, jdtype: str, precision,
+    pipelined: bool,
+):
+    """Compiled collective-matmul program for the contraction-split case
+    (``a.split == 1``, ``b.split == 0``): ``C = Σ_q A_q B_q`` as a
+    ppermute reduce-scatter ring whose per-hop partial block matmul
+    (MXU) rides under the in-flight hop (ICI), then a ring gather of
+    the reduced row chunks (``kernels.cmatmul.ring_matmul_reduce``).
+    Replicated output, consistent across devices (each chunk is summed
+    once, in fixed ring order) and bit-identical between the sequential
+    and pipelined issue orders."""
+    from ...kernels import cmatmul as _cm
+    from .._jax_compat import shard_map as _shard_map
+    from jax.sharding import PartitionSpec as _P
+
+    p = mesh.devices.size
+    # jdtype rides only in the lru_cache key: operands arrive pre-cast
+
+    def kernel(a_loc, b_loc):
+        with _cm.stamp_scope("matmul"):
+            return _cm.ring_matmul_reduce(
+                a_loc, b_loc, axis_name, p, precision=precision, pipelined=pipelined
+            )
+
+    mapped = _shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(_P(None, axis_name), _P(axis_name, None)),
+        out_specs=_P(None, None),
+        check_vma=False,
+    )
+
+    def fn(a_phys, b_phys):
+        out = mapped(a_phys, b_phys)
+        return out if out.shape[0] == m else out[:m]
+
+    return jax.jit(fn)
+
+
+def _collective_matmul_eligible(a: DNDarray, b: DNDarray) -> bool:
+    """The collective-matmul form serves exactly the contraction-split
+    2-D case — ``a`` column-split against ``b`` row-split, the one
+    matmul whose GSPMD schedule is a full-reduction barrier. Gated by
+    ``kernels.cmatmul.ring_enabled`` (``HEAT_TPU_REDIST_OVERLAP``)."""
+    return (
+        a.ndim == 2
+        and b.ndim == 2
+        and a.split == 1
+        and b.split == 0
+        and not a._is_planar
+        and not b._is_planar
+        and a.comm.is_distributed()
+    )
 
 
 def _wrap(result: jax.Array, split: Optional[int], ref: DNDarray) -> DNDarray:
@@ -158,6 +217,29 @@ def matmul(
 
         return _cp.matmul(a, b, precision=precision)
     promoted = types.promote_types(a.dtype, b.dtype)
+
+    from ...kernels import cmatmul as _cm
+
+    if _collective_matmul_eligible(a, b) and _cm.ring_enabled():
+        # the collective-matmul form (ISSUE 6): the contraction-split
+        # product's reduction decomposed into a ppermute ring so each
+        # partial block matmul lands under the in-flight hop, instead of
+        # GSPMD's full-reduction barrier. HEAT_TPU_REDIST_OVERLAP=0 is
+        # the escape hatch back to the barrier schedule below.
+        jt = promoted.jax_type()
+        comm = a.comm
+        fn = _cmatmul_program(
+            comm.mesh,
+            comm.axis_name,
+            int(a.shape[0]),
+            int(a._phys.shape[1]) // comm.size,
+            int(b.shape[1]),
+            np.dtype(jt).name,
+            precision,
+            True,
+        )
+        return _wrap(fn(a._phys.astype(jt), b._phys.astype(jt)), None, a)
+
     arr_a = a.larray.astype(promoted.jax_type())
     arr_b = b.larray.astype(promoted.jax_type())
 
@@ -397,3 +479,9 @@ def vector_norm(
 
 DNDarray.transpose = transpose
 DNDarray.__matmul__ = lambda self, other: matmul(self, other)
+
+from ..communication import register_mesh_cache as _register_mesh_cache
+
+# collective-matmul programs bake mesh geometry: cleared when
+# init_distributed rebuilds the world
+_register_mesh_cache(_cmatmul_program)

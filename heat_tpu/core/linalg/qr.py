@@ -67,8 +67,23 @@ def _tsqr_group_size(p: int) -> int:
 _TSQR_TWO_LEVEL_MIN_P = 16
 
 
+def _tsqr_ring_active() -> bool:
+    """Does TSQR run its collective-matmul merge — the R-factor
+    all-gather decomposed into a ppermute ring whose landed blocks are
+    stacked as they arrive (``kernels.cmatmul.ring_all_gather``)? Gated
+    by ``HEAT_TPU_REDIST_OVERLAP`` (forced by ``=1``, off at ``=0``,
+    TPU-only under ``auto``); the assembled stack is element-identical
+    to the all-gather's, so Q/R are bit-identical either way."""
+    from ...kernels import cmatmul as _cm
+
+    return _cm.ring_enabled()
+
+
 @functools.lru_cache(maxsize=128)
-def _tsqr_fn(mesh, axis_name: str, lrows: int, cols: int, jdtype: str, calc_q: bool):
+def _tsqr_fn(
+    mesh, axis_name: str, lrows: int, cols: int, jdtype: str, calc_q: bool,
+    ring: bool = False,
+):
     """Compiled TSQR over the mesh for physical shard shape (lrows, cols).
 
     p < 16 (or prime p): the flat schedule — ONE all-gather of the p R
@@ -80,21 +95,37 @@ def _tsqr_fn(mesh, axis_name: str, lrows: int, cols: int, jdtype: str, calc_q: b
     and replicated merge FLOPs drop from p·K² / p·K³ to
     (s + p/s)·K² / (s + p/s)·K³ — 4× at p=64, 8× at p=256, exactly the
     point PERF's model said a two-level tree becomes necessary. Q update
-    composes the two tiny block factors: Q = Q_local · Q2[j] · Q3[g]."""
+    composes the two tiny block factors: Q = Q_local · Q2[j] · Q3[g].
+
+    ``ring=True`` (the collective-matmul form, ISSUE 6): each gather —
+    flat, and both levels of the tree — runs as a ppermute ring that
+    stacks blocks as they land instead of after the all-gather barrier,
+    overlapping the assembly copies (and, on TPU, the local QR epilogue)
+    with the wire. Byte-equivalent movement ((size-1)·K·cols per level),
+    identical merge inputs, bit-identical Q/R."""
     p = mesh.devices.size
     s = _tsqr_group_size(p) if p >= _TSQR_TWO_LEVEL_MIN_P else 1
     two_level = s > 1
+    from ...kernels import cmatmul as _cm
+
+    def ring_gather(x, size, pos, perm):
+        # only called from the ring branches below
+        with _cm.stamp_scope("tsqr"):
+            return _cm.ring_all_gather(x, axis_name, size, pos, perm, pipelined=True)
 
     def kernel(a):
         # a: local shard (lrows, cols)
         q1, r1 = jnp.linalg.qr(a, mode="reduced")
         k = q1.shape[1]
         if not two_level:
-            rs = jax.lax.all_gather(r1, axis_name)  # (p, k, cols)
+            i = jax.lax.axis_index(axis_name)
+            if ring:
+                rs = ring_gather(r1, p, i, [(ss, (ss + 1) % p) for ss in range(p)])
+            else:
+                rs = jax.lax.all_gather(r1, axis_name)  # (p, k, cols)
             q2, r = jnp.linalg.qr(rs.reshape(-1, rs.shape[-1]), mode="reduced")
             if not calc_q:
                 return r
-            i = jax.lax.axis_index(axis_name)
             q2_i = jax.lax.dynamic_slice_in_dim(q2, i * k, k)
             return q1 @ q2_i, r
 
@@ -103,14 +134,22 @@ def _tsqr_fn(mesh, axis_name: str, lrows: int, cols: int, jdtype: str, calc_q: b
         g = i // s   # group id
         j = i % s    # position within group
         # level 1: gather the s member R's within each group
-        groups1 = [[gg * s + jj for jj in range(s)] for gg in range(G)]
-        rs1 = jax.lax.all_gather(r1, axis_name, axis_index_groups=groups1)
+        if ring:
+            perm1 = [(gg * s + jj, gg * s + (jj + 1) % s) for gg in range(G) for jj in range(s)]
+            rs1 = ring_gather(r1, s, j, perm1)
+        else:
+            groups1 = [[gg * s + jj for jj in range(s)] for gg in range(G)]
+            rs1 = jax.lax.all_gather(r1, axis_name, axis_index_groups=groups1)
         q2, r_g = jnp.linalg.qr(rs1.reshape(-1, rs1.shape[-1]), mode="reduced")
         k2 = q2.shape[1]
         # level 2: every group's R_g is replicated within the group, so
         # gathering across same-j columns hands every device all G of them
-        groups2 = [[gg * s + jj for gg in range(G)] for jj in range(s)]
-        rs2 = jax.lax.all_gather(r_g, axis_name, axis_index_groups=groups2)
+        if ring:
+            perm2 = [(gg * s + jj, ((gg + 1) % G) * s + jj) for gg in range(G) for jj in range(s)]
+            rs2 = ring_gather(r_g, G, g, perm2)
+        else:
+            groups2 = [[gg * s + jj for gg in range(G)] for jj in range(s)]
+            rs2 = jax.lax.all_gather(r_g, axis_name, axis_index_groups=groups2)
         q3, r = jnp.linalg.qr(rs2.reshape(-1, rs2.shape[-1]), mode="reduced")
         if not calc_q:
             return r
@@ -180,7 +219,10 @@ def qr(
     if use_tsqr:
         phys = a._phys.astype(jt)
         lrows = phys.shape[0] // comm.size
-        fn = _tsqr_fn(comm.mesh, comm.axis_name, lrows, n, np.dtype(jt).name, calc_q)
+        fn = _tsqr_fn(
+            comm.mesh, comm.axis_name, lrows, n, np.dtype(jt).name, calc_q,
+            ring=_tsqr_ring_active(),
+        )
         if calc_q:
             q_phys, r = fn(phys)
             # restore the zero-pad invariant on Q (see module docstring)

@@ -21,7 +21,12 @@ TPU-native redesign (same math, different schedule):
    vectors ``Q·U_R`` — one all-gather of R factors on ICI plus local MXU
    matmuls. Mathematically this *is* a single-level merge with exact
    arithmetic on the concatenated factors; the truncation error analysis
-   of the reference applies unchanged.
+   of the reference applies unchanged. Under the
+   ``HEAT_TPU_REDIST_OVERLAP`` gate the TSQR runs its collective-matmul
+   form (ISSUE 6): the R-factor all-gather decomposed into a ppermute
+   ring whose blocks are stacked as they land
+   (``kernels.cmatmul.ring_all_gather``) — byte-equivalent movement,
+   bit-identical factors, so everything below is form-agnostic.
 3. rank-budget (``hsvd_rank``) truncates statically; tolerance mode
    (``hsvd_rtol``) picks the final rank from the merged spectrum on host
    (a scalar-sized transfer), keeping all array shapes static under jit.

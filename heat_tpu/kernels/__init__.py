@@ -4,15 +4,23 @@ The repo's pattern (arXiv:2112.09017, applied to hSVD in
 ``core/linalg/_pallas_sketch.py``): a hand-tiled single-chip kernel
 under an UNCHANGED collective schedule is where the throughput lives.
 This package holds the kernels that are not tied to one algorithm
-module — currently the local radix/columnsort sort engines feeding both
+module — the local radix/columnsort sort engines feeding both
 ``ht.sort``'s single-chip path and the distributed sort networks'
-local-sort steps (``core/parallel.py``). Every kernel here ships with
-capability gates, a ``lax.*`` numerical oracle as the fallback, and an
+local-sort steps (``core/parallel.py``), the lane-packing relayout
+copies under the redistribution planner (``relayout``), and the
+ppermute-ring collective-matmul primitives the TSQR merge and split
+matmul overlap their compute with (``cmatmul``). Every kernel here
+ships with capability gates, a numerical oracle as the fallback, and an
 environment escape hatch.
 """
 
+from . import cmatmul
 from . import relayout
 from . import sort
+from .cmatmul import (
+    ring_all_gather,
+    ring_matmul_reduce,
+)
 from .relayout import (
     lane_fill,
     pack_rows,
@@ -27,6 +35,7 @@ from .sort import (
 )
 
 __all__ = [
+    "cmatmul",
     "relayout",
     "sort",
     "block_sort",
@@ -34,6 +43,8 @@ __all__ = [
     "lane_fill",
     "local_sort",
     "pack_rows",
+    "ring_all_gather",
+    "ring_matmul_reduce",
     "sort_plan",
     "to_sortable",
     "unpack_rows",

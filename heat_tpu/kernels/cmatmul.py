@@ -1,0 +1,193 @@
+"""Collective-matmul primitives: ppermute-ring forms of the linalg
+collectives, consumed block-by-block as they land.
+
+The TPU distributed-linalg playbook (arXiv:2112.09017) gets its
+latency hiding from *collective matmuls*: a gathered/reduced operand is
+never waited on as one barrier — the collective is decomposed into a
+ring of ``ppermute`` hops and every landed block is consumed (placed,
+multiplied, accumulated) while the next hop is on the wire. This module
+is that decomposition, shared by the TSQR merge (``core/linalg/qr.py``)
+and the split matmul (``core/linalg/basics.py``), gated by the same
+``HEAT_TPU_REDIST_OVERLAP`` knob as the redistribution executor's
+pipelined programs:
+
+- ``ring_all_gather`` — the R-factor all-gather of TSQR (flat and both
+  levels of the two-level group tree) as ``size-1`` neighbor hops, each
+  landed block written straight into the stacked buffer. The assembled
+  array is element-identical to ``lax.all_gather``'s, so the merge QR
+  consuming it is **bit-identical** to the barrier form for any input —
+  the consumable work is the assembly copy, which is exactly what
+  overlaps the wire.
+- ``ring_matmul_reduce`` — the contraction-split matmul
+  ``C = Σ_q A_q B_q`` as a reduce-scatter ring whose per-hop partial
+  block matmul (MXU) overlaps the ppermute (ICI), then a ring gather of
+  the reduced row blocks. Each output chunk is accumulated in ONE fixed
+  ring order on one device and then copied, so the replicated result is
+  consistent across devices and bit-identical between the sequential
+  and pipelined issue orders (same adds, same order).
+
+Sequential-vs-pipelined contract (the redistribution executor's): the
+sequential oracle pins compute behind wire with
+``lax.optimization_barrier`` (identity on values), the pipelined form
+frees XLA's latency-hiding scheduler / prefetch-issues the next hop.
+Both launch the same collectives — the census trades the one
+all-gather/all-reduce for a byte-equivalent ppermute chain, pinned in
+``tests/test_overlap.py``.
+
+Programs run under ``jax.named_scope("cmatmul_ring_<tag>")`` so
+shardlint recognizes the ppermute chains as planned collective-matmul
+movement (``analysis/boundaries.PLANNER_MODULES``) and reports them at
+info severity instead of flagging the subsystem's own schedules.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from jax import lax
+
+from typing import List, Tuple
+
+__all__ = [
+    "ring_enabled",
+    "ring_all_gather",
+    "ring_matmul_reduce",
+    "stamp_scope",
+]
+
+
+def ring_enabled() -> bool:
+    """Do the linalg paths run their collective-matmul (ppermute-ring)
+    forms? ``HEAT_TPU_REDIST_OVERLAP=1`` forces them everywhere (the CI
+    leg), ``=0`` restores the barrier collectives (all-gather /
+    GSPMD-scheduled reduction — the oracle), and the default ``auto``
+    engages them only on the TPU backend: unlike the redistribution
+    pipelining (a free reorder), the ring decomposition changes the
+    collective pattern, and only TPU's async collective engine turns
+    the per-hop consume into hidden time."""
+    from ..redistribution import planner as _planner
+
+    mode = _planner.overlap_mode()
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def stamp_scope(tag: str):
+    """The ``cmatmul_ring_<tag>`` named scope collective-matmul program
+    bodies run under — the stamp lands in the HLO ``op_name`` of every
+    ppermute the ring launches, which is how shardlint downgrades the
+    chain to info severity (see ``analysis/boundaries``)."""
+    return jax.named_scope(f"cmatmul_ring_{tag}")
+
+
+def ring_all_gather(
+    x: jax.Array,
+    axis_name: str,
+    size: int,
+    pos,
+    perm: List[Tuple[int, int]],
+    pipelined: bool = True,
+):
+    """Assemble ``lax.all_gather(x, axis_name)``'s ``(size,) + x.shape``
+    stack with ``size - 1`` ppermute hops, placing each block as it
+    lands.
+
+    ``pos`` is this device's (traced) index within its gather group and
+    ``perm`` the +1 ring permutation of the group (possibly grouped —
+    the two-level TSQR tree passes within-group and across-group
+    rings). After ``d`` forward hops a device holds the block of the
+    member ``d`` positions behind it, so the landed block's stack slot
+    is ``(pos - d) mod size`` — identical to the all-gather layout, for
+    any data, which is what makes the consuming merge bit-identical to
+    the barrier form.
+
+    ``pipelined=False`` is the sequential oracle: each hop's placement
+    is ``optimization_barrier``-pinned before the next hop issues.
+    """
+    if size <= 1:
+        return x[None]
+    out = jnp.zeros((size,) + x.shape, x.dtype)
+    zero = jnp.zeros((), jnp.int32)
+
+    def place(out, blk, d):
+        slot = (jnp.asarray(pos, jnp.int32) - d) % size
+        return lax.dynamic_update_slice(out, blk[None], (slot,) + (zero,) * x.ndim)
+
+    out = place(out, x, 0)
+    if pipelined:
+        prev = lax.ppermute(x, axis_name, perm)
+        for d in range(1, size - 1):
+            nxt = lax.ppermute(prev, axis_name, perm)  # hop d+1 flies ...
+            out = place(out, prev, d)  # ... while hop d's block is placed
+            prev = nxt
+        out = place(out, prev, size - 1)
+    else:
+        cur = x
+        for d in range(1, size):
+            cur = lax.ppermute(cur, axis_name, perm)
+            out = place(out, cur, d)
+            out, cur = lax.optimization_barrier((out, cur))
+    return out
+
+
+def ring_matmul_reduce(
+    a_loc: jax.Array,
+    b_loc: jax.Array,
+    axis_name: str,
+    p: int,
+    precision=None,
+    pipelined: bool = True,
+):
+    """The contraction-split matmul ``C = Σ_q A_q B_q`` as a collective
+    matmul: reduce-scatter ring with on-demand partial blocks, then a
+    ring gather of the reduced row chunks.
+
+    ``a_loc`` is the local ``(m, K/p)`` column block of A, ``b_loc`` the
+    local ``(K/p, n)`` row block of B (the physical shards of
+    ``a.split == 1`` / ``b.split == 0`` — zero pads on the contraction
+    axis contribute exact zeros). Output: the replicated
+    ``(pad(m, p), n)`` product (caller slices the row pad).
+
+    Movement: each output row chunk ``j`` (of ``p``) is accumulated
+    around the ring in the fixed order ``P_{j-1}, P_j, …, P_{j-2}`` —
+    one well-defined float addition order per chunk, computed once,
+    then ring-gathered — so every device ends with the same bits and
+    the sequential/pipelined issue orders agree exactly. Per hop the
+    partial block matmul (one ``(mc, K/p) @ (K/p, n)`` MXU call) is
+    independent of the in-flight ppermute: that is the compute the ring
+    hides under the wire (sequential oracle: pinned behind it).
+    """
+    m = a_loc.shape[0]
+    n = b_loc.shape[1]
+    mc = -(-m // p)
+    if mc * p != m:
+        a_loc = jnp.pad(a_loc, ((0, mc * p - m), (0, 0)))
+    if p <= 1:
+        return jnp.matmul(a_loc, b_loc, precision=precision)
+    r = lax.axis_index(axis_name)
+    perm = [(s, (s + 1) % p) for s in range(p)]
+
+    def partial(j):
+        rows = lax.dynamic_slice_in_dim(a_loc, j * mc, mc, axis=0)
+        return jnp.matmul(rows, b_loc, precision=precision)
+
+    # reduce-scatter: at step t device r contributes its partial for
+    # chunk (r + 1 - t) mod p and forwards the accumulator
+    acc = partial((r + 1) % p)
+    for t in range(1, p):
+        if not pipelined:
+            # oracle: the hop may not leave before this step's partial
+            # is computed — wire strictly serialized with compute
+            acc, a_loc = lax.optimization_barrier((acc, a_loc))
+        recv = lax.ppermute(acc, axis_name, perm)
+        acc = recv + partial((r + 1 - t) % p)
+    # device r now holds chunk (r + 2) mod p fully reduced; ring-gather
+    # the chunks into the replicated product — the same assembly ring as
+    # the TSQR merge, stacked by chunk slot so the row order is global
+    own = (r + 2) % p
+    stacked = ring_all_gather(acc, axis_name, p, own, perm, pipelined=pipelined)
+    return stacked.reshape(mc * p, n)

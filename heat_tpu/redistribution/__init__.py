@@ -23,9 +23,14 @@ reshape repartitions are *planned* —
   collective census must equal the plan's, and tier-1 pins it.
 
 ``ht.redistribution.explain(arr, axis)`` (or ``reshape=...``) returns
-the plan the public ``resplit``/``reshape`` APIs will execute. The
-peak-memory budget is the ``HEAT_TPU_REDIST_BUDGET_MB`` env knob;
-``HEAT_TPU_REDIST_PLANNER=0`` restores the legacy one-collective paths.
+the plan the public ``resplit``/``reshape`` APIs will execute —
+``.describe()`` renders the steps with their overlap pipe tags and the
+modeled max(wire, copy) critical-path account. The peak-memory budget
+is the ``HEAT_TPU_REDIST_BUDGET_MB`` env knob;
+``HEAT_TPU_REDIST_PLANNER=0`` restores the legacy one-collective paths;
+``HEAT_TPU_REDIST_OVERLAP=0/1/auto`` switches the executor between the
+sequential oracle and the software-pipelined program forms (same plans,
+same census, bit-identical results).
 """
 
 from . import executor
@@ -39,6 +44,7 @@ from .planner import (
     clear_plan_cache,
     explain,
     golden_specs,
+    overlap_mode,
     plan,
     planner_enabled,
 )
@@ -54,6 +60,7 @@ __all__ = [
     "execute",
     "explain",
     "golden_specs",
+    "overlap_mode",
     "plan",
     "planner_enabled",
     "reshape_phys",

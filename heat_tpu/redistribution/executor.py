@@ -18,6 +18,17 @@ Padding discipline (see ``core/_padding``): programs take the physical
 (src-split-padded) array and return the physical dst-split-padded array;
 pads along the exchanged axes are added/dropped with LOCAL copies inside
 the same program, so the zero-pad invariant holds on the way out.
+
+Software pipelining (ISSUE 6): the chunk/hop loops come in two issue
+orders — the sequential oracle (lap k's collective, then lap k's
+relayout copy: exactly the PR 5 program form, which is what the
+``HEAT_TPU_REDIST_OVERLAP=0`` escape hatch restores) and the depth-2
+pipelined form (prefetch-issue lap k+1's collective, THEN consume lap
+k), selected per-execution by the plan's overlap annotation under the
+gate (``_overlap_active``) and baked into the program cache key. Both
+forms launch identical collectives and write identical (disjoint)
+regions: census and numerics are bit-identical, pinned by
+``tests/test_overlap.py``.
 """
 
 from __future__ import annotations
@@ -89,10 +100,53 @@ def _a2a_chunks(sched: Schedule) -> Tuple[int, int]:
     return before, after
 
 
-def _chunked_all_to_all(x, axis_name: str, p: int, split_axis: int, concat_axis: int, C: int):
+def _run_laps(indices, issue, consume, state, pipelined: bool):
+    """The depth-2 double-buffer skeleton every chunk/hop loop shares.
+    ``issue(k)`` launches lap k's collective (laps are independent —
+    each slices from the source), ``consume(state, result, k)`` folds
+    lap k's received buffer into the output. Sequential: issue lap k,
+    consume lap k — exactly the PR 5 program form the
+    ``HEAT_TPU_REDIST_OVERLAP=0`` escape hatch restores. Pipelined:
+    prefetch-issue lap k+1 BEFORE consuming lap k, so the reassembly
+    copy runs while the next collective is on the wire. Same
+    collectives, disjoint writes: bit-identical either way.
+    (``kernels.cmatmul.ring_all_gather`` keeps its own loop — its hops
+    are CHAINED through the travelling block, a different dependence
+    structure.)"""
+    idx = list(indices)
+    if not pipelined or len(idx) < 2:
+        for k in idx:
+            state = consume(state, issue(k), k)
+        return state
+    prev = issue(idx[0])
+    for i in range(1, len(idx)):
+        nxt = issue(idx[i])  # lap i on the wire ...
+        state = consume(state, prev, idx[i - 1])  # ... while i-1 relayouts
+        prev = nxt
+    return consume(state, prev, idx[-1])
+
+
+def _chunked_all_to_all(
+    x, axis_name: str, p: int, split_axis: int, concat_axis: int, C: int,
+    pipelined: bool = False,
+):
     """Tiled all-to-all in C equal chunks along the concat axis, chunk
     results scattered (in place) into the destination-layout buffer.
-    C == 1 is the direct single-collective form."""
+    C == 1 is the direct single-collective form.
+
+    ``pipelined`` switches the lap loop between the two issue orders of
+    the SAME collectives (bit-identical output — the scatters write
+    disjoint regions):
+
+    - sequential (the oracle/floor, ``HEAT_TPU_REDIST_OVERLAP=0``):
+      issue lap c, scatter lap c — EXACTLY the PR 5 program form, so the
+      escape hatch restores the previously shipped schedule (no added
+      barriers; XLA keeps whatever freedom it already had);
+    - pipelined (depth 2): prefetch-issue lap c+1's all-to-all, THEN
+      scatter lap c — the received chunk's relayout copy runs while the
+      next chunk is on the wire (the ``nn/attention.py`` ring trick
+      applied to the chunk pipeline; XLA's async collective pair
+      brackets the independent copy work)."""
     if C <= 1:
         return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
     x2 = jnp.moveaxis(x, concat_axis, 0)
@@ -102,13 +156,18 @@ def _chunked_all_to_all(x, axis_name: str, p: int, split_axis: int, concat_axis:
     out_shape = (Bc * p,) + tuple(
         d // p if k + 1 == s_ax else d for k, d in enumerate(x2.shape[1:])
     )
-    out = jnp.zeros(out_shape, x.dtype)
-    for c in range(C):
+
+    def issue(c):
         chunk = lax.slice_in_dim(x2, c * step, (c + 1) * step, axis=0)
-        r = lax.all_to_all(chunk, axis_name, s_ax, 0, tiled=True)  # (p*step, ...)
+        return lax.all_to_all(chunk, axis_name, s_ax, 0, tiled=True)  # (p*step, ...)
+
+    def consume(out, r, c):
         for s in range(p):
             piece = lax.slice_in_dim(r, s * step, (s + 1) * step, axis=0)
             out = lax.dynamic_update_slice_in_dim(out, piece, s * Bc + c * step, axis=0)
+        return out
+
+    out = _run_laps(range(C), issue, consume, jnp.zeros(out_shape, x.dtype), pipelined)
     return jnp.moveaxis(out, 0, concat_axis)
 
 
@@ -128,28 +187,39 @@ def _packed_flags(sched: Schedule) -> Tuple[bool, bool]:
     return packed_in, packed_out
 
 
-def _chunked_a2a_flat(x, axis_name: str, p: int, C: int):
+def _chunked_a2a_flat(x, axis_name: str, p: int, C: int, pipelined: bool = False):
     """Tiled all-to-all of a ``(p, M)`` column-grouped FLAT buffer
     (``kernels.relayout.pack_rows`` layout): row d is the block bound
     for device d; the result's row q is the block received from device
     q. Both faces are lane-full wide buffers — the packed pivot's
-    collective form. ``C > 1`` pipelines equal column chunks (C | M)."""
+    collective form. ``C > 1`` chunks equal column laps (C | M);
+    ``pipelined`` prefetch-issues lap c+1 before placing lap c (same
+    issue-order contract as :func:`_chunked_all_to_all`)."""
     if C <= 1:
         return lax.all_to_all(x, axis_name, 0, 0, tiled=True)
     M = x.shape[1]
     step = M // C
-    out = jnp.zeros_like(x)
-    for c in range(C):
+
+    def issue(c):
         chunk = lax.slice_in_dim(x, c * step, (c + 1) * step, axis=1)
-        r = lax.all_to_all(chunk, axis_name, 0, 0, tiled=True)
-        out = lax.dynamic_update_slice_in_dim(out, r, c * step, axis=1)
-    return out
+        return lax.all_to_all(chunk, axis_name, 0, 0, tiled=True)
+
+    def consume(out, r, c):
+        return lax.dynamic_update_slice_in_dim(out, r, c * step, axis=1)
+
+    return _run_laps(range(C), issue, consume, jnp.zeros_like(x), pipelined)
 
 
-def _ring_exchange(x, axis_name: str, p: int, split_axis: int, concat_axis: int):
+def _ring_exchange(
+    x, axis_name: str, p: int, split_axis: int, concat_axis: int,
+    pipelined: bool = False,
+):
     """The same split i->j move as p-1 ppermute hops: at distance d every
     device ships ONE neighbor block, so only 2·(local/p) bytes are in
-    flight per step — the minimal-footprint schedule."""
+    flight per step — the minimal-footprint schedule. ``pipelined``
+    prefetch-issues hop d+1's ppermute before scattering hop d's
+    received block (hops slice independently from ``x``, so the rotation
+    is a pure reorder: same hops, bit-identical output)."""
     r = lax.axis_index(axis_name)
     S = x.shape[split_axis]
     Bs = S // p
@@ -158,24 +228,33 @@ def _ring_exchange(x, axis_name: str, p: int, split_axis: int, concat_axis: int)
         d * p if k == concat_axis else (Bs if k == split_axis else d)
         for k, d in enumerate(x.shape)
     )
+
+    def hop(d):
+        blk = lax.dynamic_slice_in_dim(x, ((r + d) % p) * Bs, Bs, axis=split_axis)
+        return lax.ppermute(blk, axis_name, [(s, (s + d) % p) for s in range(p)])
+
+    def place(out, recv, d):
+        return lax.dynamic_update_slice_in_dim(
+            out, recv, ((r - d) % p) * Bc, axis=concat_axis
+        )
+
     out = jnp.zeros(out_shape, x.dtype)
     own = lax.dynamic_slice_in_dim(x, r * Bs, Bs, axis=split_axis)
     out = lax.dynamic_update_slice_in_dim(out, own, r * Bc, axis=concat_axis)
-    for d in range(1, p):
-        blk = lax.dynamic_slice_in_dim(x, ((r + d) % p) * Bs, Bs, axis=split_axis)
-        recv = lax.ppermute(blk, axis_name, [(s, (s + d) % p) for s in range(p)])
-        out = lax.dynamic_update_slice_in_dim(out, recv, ((r - d) % p) * Bc, axis=concat_axis)
-    return out
+    return _run_laps(range(1, p), hop, place, out, pipelined)
 
 
 # --------------------------------------------------------------------- #
 # program builders (one compiled program per (comm, spec, budget))      #
 # --------------------------------------------------------------------- #
 @functools.lru_cache(maxsize=512)
-def _move_program(comm, spec: RedistSpec, budget: int):
+def _move_program(comm, spec: RedistSpec, budget: int, pipelined: bool = False):
     """split i -> split j (all-to-all / chunked / ring) on the physical
     array: pad dst axis (local) -> shard_map exchange -> drop src-axis
-    pad (local)."""
+    pad (local). ``pipelined`` selects the depth-2 prefetch-issue form
+    of the chunk/hop loops (same collectives, bit-identical output) and
+    is part of the program cache key — flipping the
+    ``HEAT_TPU_REDIST_OVERLAP`` gate rebuilds the program."""
     sched = _planner.plan(spec, budget)
     mesh, axis_name = comm.mesh, comm.axis_name
     p = spec.mesh_size
@@ -188,8 +267,12 @@ def _move_program(comm, spec: RedistSpec, budget: int):
 
     def body(xl):
         if ring:
-            return _ring_exchange(xl, axis_name, p, split_axis=j, concat_axis=i)
-        return _chunked_all_to_all(xl, axis_name, p, split_axis=j, concat_axis=i, C=C)
+            return _ring_exchange(
+                xl, axis_name, p, split_axis=j, concat_axis=i, pipelined=pipelined
+            )
+        return _chunked_all_to_all(
+            xl, axis_name, p, split_axis=j, concat_axis=i, C=C, pipelined=pipelined
+        )
 
     mapped = shard_map(
         body,
@@ -215,10 +298,11 @@ def _move_program(comm, spec: RedistSpec, budget: int):
 
 
 @functools.lru_cache(maxsize=512)
-def _pivot_program(comm, spec: RedistSpec, budget: int):
+def _pivot_program(comm, spec: RedistSpec, budget: int, pipelined: bool = False):
     """Reshape-with-repartition through the split-0 pivot: all-to-all to
     the flat-contiguous split-0 layout, LOCAL row-major reshape (the
-    minor-dim packing copy runs at full width), all-to-all out."""
+    minor-dim packing copy runs at full width), all-to-all out. Both
+    chunk groups run ``pipelined`` as decorated prefetch-issue loops."""
     sched = _planner.plan(spec, budget)
     mesh, axis_name = comm.mesh, comm.axis_name
     p = spec.mesh_size
@@ -231,7 +315,9 @@ def _pivot_program(comm, spec: RedistSpec, budget: int):
     def body(xl):
         y = xl
         if s is not None and s != 0:
-            y = _chunked_all_to_all(y, axis_name, p, split_axis=0, concat_axis=s, C=C1)
+            y = _chunked_all_to_all(
+                y, axis_name, p, split_axis=0, concat_axis=s, C=C1, pipelined=pipelined
+            )
             in_s, in_sp = in_shape[s], _pad_extent(in_shape[s], p)
             if in_sp != in_s:
                 y = lax.slice_in_dim(y, 0, in_s, axis=s)
@@ -243,7 +329,9 @@ def _pivot_program(comm, spec: RedistSpec, budget: int):
                 widths = [(0, 0)] * ndim_out
                 widths[t] = (0, out_tp - out_t)
                 y = jnp.pad(y, widths)
-            y = _chunked_all_to_all(y, axis_name, p, split_axis=t, concat_axis=0, C=C2)
+            y = _chunked_all_to_all(
+                y, axis_name, p, split_axis=t, concat_axis=0, C=C2, pipelined=pipelined
+            )
         return y
 
     mapped = shard_map(
@@ -292,7 +380,9 @@ def _relayout_impls(
 
 
 @functools.lru_cache(maxsize=512)
-def _packed_pivot_program(comm, spec: RedistSpec, budget: int, impl_in, impl_out):
+def _packed_pivot_program(
+    comm, spec: RedistSpec, budget: int, impl_in, impl_out, pipelined: bool = False
+):
     """The lane-packing pivot (``packed-pivot``): narrow-minor stages
     run on (p, rows·cols/p) column-grouped FLAT buffers so the chunked
     all-to-alls stream full VREGs; the pack/unpack tile-transposing
@@ -318,10 +408,13 @@ def _packed_pivot_program(comm, spec: RedistSpec, budget: int, impl_in, impl_out
         if s == 1:
             if packed_in:
                 grouped = xl.reshape(p, R0 * cs0)  # free row-block grouping
-                recv = _chunked_a2a_flat(grouped, axis_name, p, C1)
+                recv = _chunked_a2a_flat(grouped, axis_name, p, C1, pipelined=pipelined)
                 flat = _relayout.unpack_rows(recv, R0, c0p, c0, p, impl=impl_in)
             else:
-                y = _chunked_all_to_all(xl, axis_name, p, split_axis=0, concat_axis=1, C=C1)
+                y = _chunked_all_to_all(
+                    xl, axis_name, p, split_axis=0, concat_axis=1, C=C1,
+                    pipelined=pipelined,
+                )
                 if c0p != c0:
                     y = lax.slice_in_dim(y, 0, c0, axis=1)
                 flat = y.reshape(R0 * c0)
@@ -330,14 +423,16 @@ def _packed_pivot_program(comm, spec: RedistSpec, budget: int, impl_in, impl_out
         if t == 1:
             if packed_out:
                 grouped = _relayout.pack_rows(flat, R1, c1, c1p, p, impl=impl_out)
-                recv = _chunked_a2a_flat(grouped, axis_name, p, C2)
+                recv = _chunked_a2a_flat(grouped, axis_name, p, C2, pipelined=pipelined)
                 # rows arrive in global order: the reshape IS the single
                 # lane-amplified materialization of the requested layout
                 return recv.reshape(r1, cs1)
             y = flat.reshape(R1, c1)
             if c1p != c1:
                 y = jnp.pad(y, ((0, 0), (0, c1p - c1)))
-            return _chunked_all_to_all(y, axis_name, p, split_axis=1, concat_axis=0, C=C2)
+            return _chunked_all_to_all(
+                y, axis_name, p, split_axis=1, concat_axis=0, C=C2, pipelined=pipelined
+            )
         return flat.reshape(R1, c1)
 
     mapped = shard_map(
@@ -425,6 +520,21 @@ _register_mesh_cache(_local_reshape_program)
 # --------------------------------------------------------------------- #
 # execution                                                             #
 # --------------------------------------------------------------------- #
+def _overlap_active(sched: Schedule) -> bool:
+    """Does this execution run the software-pipelined program form?
+    ``HEAT_TPU_REDIST_OVERLAP=0`` forces the sequential oracle, ``=1``
+    forces pipelining, and the default ``auto`` follows the plan's own
+    overlap annotation (the planner's modeled depth decision). Either
+    way the plan — and therefore the collective census — is the same;
+    only the issue order inside the chunk loops changes."""
+    mode = _planner.overlap_mode()
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    return sched.overlap is not None
+
+
 def _reshard_direct(comm, phys, gshape, src, dst):
     """The legacy relayout (unpad -> repad -> placement): still the
     lowering for the no-collective strategies, where GSPMD's local
@@ -458,6 +568,17 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
         _telemetry.inc("redist.execute.calls")
     strategy = sched.strategy
     budget = sched.budget_bytes
+    # a program only HAS a pipelined issue order when the plan carries
+    # tagged laps (chunk groups / ring hops): single-collective plans and
+    # the barrier strategies (replicate/gather-reshape/local-reshape)
+    # must neither count as pipelined executions nor compile a second,
+    # identical program under the pipelined cache key
+    pipeable = any(st.overlap for st in sched.steps)
+    pipelined = _overlap_active(sched) and pipeable
+    if _telemetry._ENABLED and strategy not in ("noop", "local", "slice"):
+        _telemetry.inc(
+            "redist.overlap.pipelined" if pipelined else "redist.overlap.sequential"
+        )
     if strategy == "noop":
         return phys
     if strategy in ("slice",) or (strategy == "local" and not spec.is_reshape):
@@ -469,24 +590,26 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
         # its SL102 finding reports as info with the plan id attached
         return _gather_reshape_program(comm, spec, budget)(phys)
     if strategy in ("all-to-all", "chunked-all-to-all", "ring"):
-        return _move_program(comm, spec, budget)(phys)
+        return _move_program(comm, spec, budget, pipelined)(phys)
     if strategy == "split0-pivot":
         if _telemetry._ENABLED:
             _telemetry.inc("redist.relayout.direct")
-        return _pivot_program(comm, spec, budget)(phys)
+        return _pivot_program(comm, spec, budget, pipelined)(phys)
     if strategy == "packed-pivot":
         if _telemetry._ENABLED:
             _telemetry.inc("redist.relayout.packed")
         impl_in, impl_out = _relayout_impls(
             spec, sched, concrete=not isinstance(phys, jax.core.Tracer)
         )
-        return _packed_pivot_program(comm, spec, budget, impl_in, impl_out)(phys)
+        return _packed_pivot_program(comm, spec, budget, impl_in, impl_out, pipelined)(
+            phys
+        )
     if strategy == "gather-reshape":
         return _gather_reshape_program(comm, spec, budget)(phys)
     if strategy in ("local-reshape", "local"):
         if spec.src_split == 0 and spec.dst_split == 0 and spec.mesh_size > 1:
             # divisible split-0 <-> split-0: device blocks stay put
-            return _pivot_program(comm, spec, budget)(phys)
+            return _pivot_program(comm, spec, budget, pipelined)(phys)
         return _local_reshape_program(comm, spec, budget)(phys)
     raise ValueError(f"unknown strategy {strategy!r} (plan {sched.plan_id})")
 

@@ -62,6 +62,18 @@ Local copy steps (pad/slice/reshape/pack/unpack) are bounded by one
 shard and are accounted but not chunkable — the budget must be at
 least one destination shard.
 
+Overlap (ISSUE 6): exchanges big enough to amortize per-lap launch
+latency are chunked to the ``OVERLAP_GRAIN_BYTES`` grain even when the
+budget alone would not require it, and every chunk group (and the
+ppermute ring) carries a depth-2 **overlap annotation** — the modeled
+critical path prices a pipelined stage pair at ``max(wire, copy)``
+instead of ``wire + copy`` (arXiv:2112.09017's latency-hiding
+schedules). The lap structure is gate-INDEPENDENT, so the collective
+census is identical overlap-on vs overlap-off; ``HEAT_TPU_REDIST_OVERLAP``
+only switches the executor between the sequential oracle and the
+prefetch-issue-then-consume program form. The annotation folds into the
+canonical serialization and ``plan_id``.
+
 Plans are cached per ``(spec, budget)`` and feed the PR-1 telemetry
 registry: ``redist.plan_cache.{hit,miss}``, ``redist.planned_bytes``,
 ``redist.steps``, ``redist.peak_bytes``.
@@ -84,10 +96,12 @@ from .spec import RedistSpec
 __all__ = [
     "ALPHA_BYTES",
     "DEFAULT_BUDGET_MB",
+    "OVERLAP_ENV",
     "budget_bytes",
     "clear_plan_cache",
     "explain",
     "golden_specs",
+    "overlap_mode",
     "plan",
     "planner_enabled",
 ]
@@ -100,6 +114,16 @@ ALPHA_BYTES = 1 << 20
 DEFAULT_BUDGET_MB = 256
 _BUDGET_ENV = "HEAT_TPU_REDIST_BUDGET_MB"
 _ENABLE_ENV = "HEAT_TPU_REDIST_PLANNER"
+OVERLAP_ENV = "HEAT_TPU_REDIST_OVERLAP"
+
+#: pipelinable exchanges are chunked into laps of roughly this size even
+#: when the peak-memory budget alone would not require chunking — laps
+#: are what the depth-2 pipeline overlaps (chunk k's relayout copy under
+#: chunk k+1's wire). Gate-INDEPENDENT: the lap structure (and therefore
+#: the collective census) is identical overlap-on and overlap-off; the
+#: HEAT_TPU_REDIST_OVERLAP gate only controls the executor's issue order.
+OVERLAP_GRAIN_BYTES = 32 << 20
+_OVERLAP_MAX_LAPS = 4
 
 _plan_lock = threading.Lock()
 _plan_cache: Dict[Tuple[RedistSpec, int], Schedule] = {}
@@ -113,6 +137,24 @@ def planner_enabled() -> bool:
     the legacy single-device_put relayout paths)."""
     val = os.environ.get(_ENABLE_ENV, "1").strip().lower()
     return val not in ("0", "false", "off", "no")
+
+
+def overlap_mode() -> str:
+    """Resolved ``HEAT_TPU_REDIST_OVERLAP`` mode (``"0"``/``"1"``/
+    ``"auto"``). ``0`` forces every executor program (and the linalg
+    collective-matmul forms) into the sequential oracle, ``1`` forces
+    the software-pipelined forms everywhere they exist, and the default
+    ``auto`` follows the plan's overlap annotation for redistribution
+    programs (pipelining is a free reordering — bit-identical, census
+    unchanged) while the linalg ring decompositions, which trade an
+    all-gather/all-reduce for a byte-equivalent ppermute ring, engage
+    only on the TPU backend where the latency hiding pays."""
+    v = os.environ.get(OVERLAP_ENV, "auto").strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return "0"
+    if v in ("1", "on", "true", "force", "yes"):
+        return "1"
+    return "auto"
 
 
 def budget_bytes() -> int:
@@ -210,6 +252,89 @@ def _exchange_fill(shape, i: int, j: int, p: int) -> float:
 
 
 # --------------------------------------------------------------------- #
+# overlap (software-pipelining) model                                   #
+# --------------------------------------------------------------------- #
+def _overlap_laps(L: int) -> int:
+    """Lap count the pipeline wants for an exchange of ``L`` local
+    bytes: ~OVERLAP_GRAIN_BYTES laps (capped) once the buffer is big
+    enough that per-lap ALPHA overhead is noise, else 1 (no pipelining —
+    small moves stay one collective and the pinned censuses hold)."""
+    L = int(L)
+    if L < 2 * OVERLAP_GRAIN_BYTES:
+        return 1
+    return min(_OVERLAP_MAX_LAPS, L // OVERLAP_GRAIN_BYTES)
+
+
+def _lap_count(extent: int, L: int, budget: int) -> int:
+    """Chunk count for a pipelinable exchange over ``extent``: the
+    larger of the budget requirement and the overlap grain, rounded to a
+    divisor of ``extent``. Overlap-motivated chunking is BEST-EFFORT:
+    equal laps need a divisor, and an extent with no small one (a prime
+    extent rounds all the way up to ``extent`` itself) must not explode
+    into a million-step schedule for a move the budget was happy to run
+    in one collective — past 4x the grain cap the overlap ask is
+    dropped and only the budget requirement stands."""
+    need_budget = -(-2 * L // budget)
+    c_budget = _divisor_chunks(extent, need_budget)
+    want = max(need_budget, _overlap_laps(L))
+    if want <= need_budget:
+        return c_budget
+    c = _divisor_chunks(extent, want)
+    if c > 4 * _OVERLAP_MAX_LAPS:
+        return c_budget
+    return c
+
+
+def _overlap_group(tag: str, laps: int, wire_bytes: int, copy_bytes: int) -> Optional[dict]:
+    """Critical-path model of one pipelined chunk group at depth 2.
+    Sequentially each lap pays ``wire + copy`` (the collective, then the
+    reassembly copy of its result); double-buffered, lap k's copy runs
+    under lap k+1's wire, so the steady state costs ``max(wire, copy)``
+    per stage pair and only the first wire / last copy are exposed:
+
+        critical_path = w + (laps - 1) * max(w, c) + c
+        (w = wire_bytes / laps, c = copy_bytes / laps)
+
+    Returns ``None`` when there is nothing to pipeline (laps < 2) or the
+    model shows no gain."""
+    laps = int(laps)
+    wire_bytes, copy_bytes = int(wire_bytes), int(copy_bytes)
+    if laps < 2:
+        return None
+    w, c = wire_bytes // laps, copy_bytes // laps
+    cp = w + (laps - 1) * max(w, c) + c
+    seq = wire_bytes + copy_bytes
+    if cp >= seq:
+        return None
+    return {
+        "tag": tag,
+        "laps": laps,
+        "wire_bytes": wire_bytes,
+        "copy_bytes": copy_bytes,
+        "sequential_bytes": seq,
+        "critical_path_bytes": int(cp),
+    }
+
+
+def _overlap_annotation(groups: List[Optional[dict]]) -> Optional[dict]:
+    """Fold per-group critical-path models into the Schedule-level
+    annotation (None when no group pipelines — the plan is sequential
+    and serializes without the key's contents)."""
+    groups = [g for g in groups if g]
+    if not groups:
+        return None
+    seq = sum(g["sequential_bytes"] for g in groups)
+    cp = sum(g["critical_path_bytes"] for g in groups)
+    return {
+        "depth": 2,
+        "groups": groups,
+        "sequential_bytes": int(seq),
+        "critical_path_bytes": int(cp),
+        "model_speedup": round(seq / cp, 4),
+    }
+
+
+# --------------------------------------------------------------------- #
 # candidate builders                                                    #
 # --------------------------------------------------------------------- #
 def _a2a_chunk_steps(
@@ -220,11 +345,15 @@ def _a2a_chunk_steps(
     pad_step: Optional[Step],
     tail_slice: Optional[Step],
     lane_fill: float = 1.0,
+    pipe: Optional[str] = None,
 ) -> List[Step]:
     """C laps of slice -> all-to-all, then a scatter reassembly (written
     in place into the destination buffer: no transient). ``lane_fill``
     annotates the collective steps with the VREG fill of the buffers
-    they stream (1.0 = full lanes, the packed forms)."""
+    they stream (1.0 = full lanes, the packed forms). ``pipe`` tags the
+    lap steps as one software-pipelined group (C >= 2 only): the
+    executor may then overlap chunk k's scatter with chunk k+1's
+    collective."""
     steps: List[Step] = []
     if pad_step is not None:
         steps.append(pad_step)
@@ -242,7 +371,13 @@ def _a2a_chunk_steps(
     else:
         for c in range(C):
             steps.append(
-                Step("slice", peak_bytes=L // C, detail=f"chunk {c}/{C} of {what}", chunk=c)
+                Step(
+                    "slice",
+                    peak_bytes=L // C,
+                    detail=f"chunk {c}/{C} of {what}",
+                    chunk=c,
+                    overlap=pipe,
+                )
             )
             steps.append(
                 Step(
@@ -252,12 +387,30 @@ def _a2a_chunk_steps(
                     detail=what,
                     chunk=c,
                     lane_fill=lane_fill,
+                    overlap=pipe,
                 )
             )
-        steps.append(Step("concat", peak_bytes=0, detail="scatter chunks into dst shard"))
+        steps.append(
+            Step(
+                "concat",
+                peak_bytes=0,
+                detail="scatter chunks into dst shard",
+                overlap=pipe,
+            )
+        )
     if tail_slice is not None:
         steps.append(tail_slice)
     return steps
+
+
+def _a2a_group(tag: str, L: int, p: int, C: int, lane_fill: float) -> Optional[dict]:
+    """Overlap group for a C-lap chunked all-to-all of ``L`` local
+    bytes: wire = the crossing payload, copy = the scatter reassembly
+    write of the received laps, both lane-amplified like the cost
+    model's step accounting."""
+    fill = max(float(lane_fill), 1e-9)
+    crossing = L * (p - 1) // p
+    return _overlap_group(tag, C, int(crossing / fill), int(L / fill))
 
 
 def _resplit_candidates(spec: RedistSpec, budget: int) -> List[Schedule]:
@@ -278,19 +431,21 @@ def _resplit_candidates(spec: RedistSpec, budget: int) -> List[Schedule]:
         else None
     )
     # concat axis is the source split axis: its local extent is what the
-    # chunk laps tile over
+    # chunk laps tile over. Laps come from the tighter of the budget
+    # requirement and the overlap grain (pipelinable buffers chunk even
+    # under a roomy budget so the executor has stages to double-buffer).
     concat_extent = Nip // p
-    needed = -(-2 * L // budget)
-    C = _divisor_chunks(concat_extent, needed)
+    C = _lap_count(concat_extent, L, budget)
 
     what = f"split {i}->{j}"
     fill = _exchange_fill(spec.gshape, i, j, p)
     a2a = Schedule(
         spec,
         "all-to-all" if C <= 1 else "chunked-all-to-all",
-        _a2a_chunk_steps(L, p, C, what, pad_step, tail, lane_fill=fill),
+        _a2a_chunk_steps(L, p, C, what, pad_step, tail, lane_fill=fill, pipe="pipe0"),
         budget,
         notes=f"C={C} chunks over local axis-{i} extent {concat_extent}" if C > 1 else "",
+        overlap=_overlap_annotation([_a2a_group("pipe0", L, p, C, fill)]) if C > 1 else None,
     )
 
     ring_steps: List[Step] = []
@@ -305,16 +460,29 @@ def _resplit_candidates(spec: RedistSpec, budget: int) -> List[Schedule]:
                 peak_bytes=2 * blk,
                 detail=f"hop distance {d}: neighbor block of {what}",
                 lane_fill=fill,
+                overlap="ring0" if p > 2 else None,
             )
         )
     if tail is not None:
         ring_steps.append(tail)
+    # ring overlap: hop d+1's ppermute flies while hop d's received
+    # block is scattered into the destination (wire = copy = one
+    # neighbor block per hop)
+    ring_group = (
+        _overlap_group(
+            "ring0", p - 1, int(blk * (p - 1) / max(fill, 1e-9)),
+            int(blk * (p - 1) / max(fill, 1e-9)),
+        )
+        if p > 2
+        else None
+    )
     ring = Schedule(
         spec,
         "ring",
         ring_steps,
         budget,
         notes="p-1 ppermute hops, one neighbor block in flight per step",
+        overlap=_overlap_annotation([ring_group]),
     )
     return [a2a, ring]
 
@@ -341,6 +509,7 @@ def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
     s, t = spec.src_split, spec.dst_split
     item = spec.itemsize
     steps: List[Step] = []
+    groups: List[Optional[dict]] = []
     shard = spec.size // p * item  # logical bytes per device block
 
     n_coll = 0
@@ -348,13 +517,13 @@ def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
         L1 = _prod(
             [_pad_extent(d, p) if ax == s else d for ax, d in enumerate(spec.gshape)]
         ) // p * item
-        C1 = _divisor_chunks(
-            _pad_extent(spec.gshape[s], p) // p, -(-2 * L1 // budget)
-        )
+        C1 = _lap_count(_pad_extent(spec.gshape[s], p) // p, L1, budget)
+        fill_in = _exchange_fill(spec.gshape, s, 0, p)
         steps += _a2a_chunk_steps(
             L1, p, C1, f"split {s}->0 (pivot in)", None, None,
-            lane_fill=_exchange_fill(spec.gshape, s, 0, p),
+            lane_fill=fill_in, pipe="pipe0",
         )
+        groups.append(_a2a_group("pipe0", L1, p, C1, fill_in) if C1 > 1 else None)
         n_coll += C1
         if _pad_extent(spec.gshape[s], p) != spec.gshape[s]:
             steps.append(
@@ -388,11 +557,13 @@ def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
                     detail=f"pad axis {t} {out_t}->{out_tp} (local)",
                 )
             )
-        C2 = _divisor_chunks(spec.out_shape[0] // p, -(-2 * L2 // budget))
+        C2 = _lap_count(spec.out_shape[0] // p, L2, budget)
+        fill_out = _exchange_fill(spec.out_shape, 0, t, p)
         steps += _a2a_chunk_steps(
             L2, p, C2, f"split 0->{t} (pivot out)", None, None,
-            lane_fill=_exchange_fill(spec.out_shape, 0, t, p),
+            lane_fill=fill_out, pipe="pipe1",
         )
+        groups.append(_a2a_group("pipe1", L2, p, C2, fill_out) if C2 > 1 else None)
         n_coll += C2
     strategy = "split0-pivot" if n_coll else "local-reshape"
     return Schedule(
@@ -401,6 +572,7 @@ def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
         steps,
         budget,
         notes="minor-dim packing: heavy copies run on the split-0 layout",
+        overlap=_overlap_annotation(groups),
     )
 
 
@@ -440,14 +612,17 @@ def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
     shard = spec.size // p * item
     packed_in, packed_out = _packed_sides(spec)
     steps: List[Step] = []
+    groups: List[Optional[dict]] = []
 
     if s == 1:
         L1 = r0 * c0p // p * item
-        C1 = _divisor_chunks(c0p // p, -(-2 * L1 // budget))
+        C1 = _lap_count(c0p // p, L1, budget)
         if packed_in:
             steps += _a2a_chunk_steps(
-                L1, p, C1, "split 1->0 (packed pivot in)", None, None, lane_fill=1.0
+                L1, p, C1, "split 1->0 (packed pivot in)", None, None,
+                lane_fill=1.0, pipe="pipe0",
             )
+            groups.append(_a2a_group("pipe0", L1, p, C1, 1.0) if C1 > 1 else None)
             steps.append(
                 Step(
                     "unpack",
@@ -461,10 +636,12 @@ def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
                 )
             )
         else:
+            fill_in = _exchange_fill(spec.gshape, 1, 0, p)
             steps += _a2a_chunk_steps(
                 L1, p, C1, f"split {s}->0 (pivot in)", None, None,
-                lane_fill=_exchange_fill(spec.gshape, 1, 0, p),
+                lane_fill=fill_in, pipe="pipe0",
             )
+            groups.append(_a2a_group("pipe0", L1, p, C1, fill_in) if C1 > 1 else None)
             if c0p != c0:
                 steps.append(
                     Step("slice", peak_bytes=shard, detail="drop axis 1 pad (local)")
@@ -479,7 +656,7 @@ def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
     )
     if t == 1:
         L2 = r1 * c1p // p * item
-        C2 = _divisor_chunks(R1, -(-2 * L2 // budget))
+        C2 = _lap_count(R1, L2, budget)
         if packed_out:
             steps.append(
                 Step(
@@ -494,8 +671,10 @@ def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
                 )
             )
             steps += _a2a_chunk_steps(
-                L2, p, C2, "split 0->1 (packed pivot out)", None, None, lane_fill=1.0
+                L2, p, C2, "split 0->1 (packed pivot out)", None, None,
+                lane_fill=1.0, pipe="pipe1",
             )
+            groups.append(_a2a_group("pipe1", L2, p, C2, 1.0) if C2 > 1 else None)
             steps.append(
                 Step(
                     "unpack",
@@ -519,10 +698,12 @@ def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
                         detail=f"pad axis 1 {c1}->{c1p} (local)",
                     )
                 )
+            fill_out = _exchange_fill(spec.out_shape, 0, 1, p)
             steps += _a2a_chunk_steps(
                 L2, p, C2, f"split 0->{t} (pivot out)", None, None,
-                lane_fill=_exchange_fill(spec.out_shape, 0, 1, p),
+                lane_fill=fill_out, pipe="pipe1",
             )
+            groups.append(_a2a_group("pipe1", L2, p, C2, fill_out) if C2 > 1 else None)
     return Schedule(
         spec,
         "packed-pivot",
@@ -532,6 +713,7 @@ def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
             "lane-packing pivot: collectives and heavy copies run on packed "
             "full-lane buffers (HEAT_TPU_RELAYOUT_KERNEL gates the tiled-copy kernel)"
         ),
+        overlap=_overlap_annotation(groups),
     )
 
 
@@ -603,7 +785,10 @@ def _select(candidates: List[Schedule]) -> Schedule:
         f"over budget: peak {best.peak_bytes} B > {best.budget_bytes} B "
         "(smallest-footprint candidate chosen)"
     )
-    return Schedule(best.spec, best.strategy, best.steps, best.budget_bytes, notes=notes)
+    return Schedule(
+        best.spec, best.strategy, best.steps, best.budget_bytes,
+        notes=notes, overlap=best.overlap,
+    )
 
 
 # --------------------------------------------------------------------- #
@@ -699,6 +884,10 @@ def plan(spec: RedistSpec, budget: Optional[int] = None) -> Schedule:
             collectives=sched.collective_counts(),
             peak_bytes=sched.peak_bytes,
             budget_bytes=b,
+            overlap_depth=sched.overlap_depth,
+            critical_path_model=(
+                sched.overlap["model_speedup"] if sched.overlap else None
+            ),
         )
     return sched
 

@@ -19,6 +19,19 @@ it runs").
 Plans serialize canonically (``canonical_json``): byte-identical
 run-to-run for the same spec + budget, since the ``plan_id`` derived
 from that serialization keys the executor's program cache.
+
+ISSUE 6 adds the **overlap annotation**: steps that belong to a
+software-pipelined chunk group carry an ``overlap`` tag, and the
+schedule carries a modeled critical-path account per group — at pipeline
+depth 2 a stage pair costs ``max(wire, copy)`` instead of ``wire +
+copy``, because chunk k's relayout copy runs while chunk k+1's
+collective is on the wire (arXiv:2112.09017's latency-hiding schedules
+applied to the chunk pipelines of arXiv:2112.01075). The annotation is
+part of the canonical serialization (and therefore of ``plan_id``); the
+executor consults it (plus the ``HEAT_TPU_REDIST_OVERLAP`` gate) to
+decide whether to emit the prefetch-issue-then-consume program form.
+Pipelining never changes WHAT moves — census and numerics are
+bit-identical overlap-on vs overlap-off by construction.
 """
 
 from __future__ import annotations
@@ -65,11 +78,15 @@ class Step:
         1/lane_fill is the HBM amplification the cost model charges.
     detail : short human-readable description of what the step does.
     chunk : chunk index when the step is one lap of a chunked pipeline.
+    overlap : pipeline-group tag (e.g. ``"pipe0"``) when the step is one
+        lap of a software-pipelined chunk group — chunk k's local work
+        overlaps chunk k+1's collective inside the group; ``None`` for
+        steps the executor issues sequentially.
     """
 
     __slots__ = (
         "kind", "bytes_moved", "bytes_copied", "peak_bytes", "lane_fill",
-        "detail", "chunk",
+        "detail", "chunk", "overlap",
     )
 
     def __init__(
@@ -81,6 +98,7 @@ class Step:
         chunk: Optional[int] = None,
         bytes_copied: int = 0,
         lane_fill: float = 1.0,
+        overlap: Optional[str] = None,
     ):
         if kind not in COLLECTIVE_STEP_KINDS and kind not in _LOCAL_STEP_KINDS:
             raise ValueError(f"unknown step kind {kind!r}")
@@ -91,6 +109,7 @@ class Step:
         self.lane_fill = float(lane_fill)
         self.detail = detail
         self.chunk = chunk
+        self.overlap = overlap
 
     @property
     def is_collective(self) -> bool:
@@ -111,6 +130,7 @@ class Step:
             "lane_fill": self.lane_fill,
             "detail": self.detail,
             "chunk": self.chunk,
+            "overlap": self.overlap,
         }
 
     def __repr__(self) -> str:
@@ -119,7 +139,28 @@ class Step:
 
 
 class Schedule:
-    """An ordered redistribution plan for one :class:`RedistSpec`."""
+    """An ordered redistribution plan for one :class:`RedistSpec`.
+
+    ``overlap`` (optional) is the software-pipelining annotation the
+    planner attaches when the plan's chunk groups can hide local copy
+    work under collective wire time::
+
+        {
+          "depth": 2,                      # pipeline depth (double-buffer)
+          "groups": [{"tag": "pipe0", "laps": C,
+                      "wire_bytes": ..., "copy_bytes": ...,
+                      "sequential_bytes": wire + copy,
+                      "critical_path_bytes": w + (C-1)*max(w, c) + c}, ...],
+          "sequential_bytes":   sum of group sequential models,
+          "critical_path_bytes": sum of group critical paths,
+          "model_speedup":      sequential / critical-path  (the bench
+                                ``critical_path_model`` field),
+        }
+
+    The annotation is cost MODEL, not movement: an overlapped program
+    launches exactly the same collectives in the same order, so census
+    and numerics are identical to the sequential form.
+    """
 
     def __init__(
         self,
@@ -128,12 +169,14 @@ class Schedule:
         steps: List[Step],
         budget_bytes: int,
         notes: str = "",
+        overlap: Optional[Dict[str, Any]] = None,
     ):
         self.spec = spec
         self.strategy = strategy
         self.steps: List[Step] = list(steps)
         self.budget_bytes = int(budget_bytes)
         self.notes = notes
+        self.overlap = overlap
         self.plan_id = hashlib.sha1(
             self.canonical_json(with_plan_id=False).encode()
         ).hexdigest()[:12]
@@ -174,6 +217,39 @@ class Schedule:
     def within_budget(self) -> bool:
         return self.peak_bytes <= self.budget_bytes
 
+    @property
+    def overlap_depth(self) -> int:
+        """Pipeline depth the executor runs the chunk groups at: 2
+        (double-buffered) when the plan carries an overlap annotation,
+        1 (sequential) otherwise."""
+        return int(self.overlap["depth"]) if self.overlap else 1
+
+    @property
+    def critical_path_bytes(self) -> int:
+        """Modeled byte-equivalent time of the plan's movement under
+        depth-2 pipelining: the non-pipelined steps at face value plus
+        each overlap group's ``max(wire, copy)``-per-stage-pair critical
+        path (equals :attr:`sequential_model_bytes` when nothing
+        pipelines)."""
+        base = self.sequential_model_bytes
+        if not self.overlap:
+            return base
+        return base - int(self.overlap["sequential_bytes"]) + int(
+            self.overlap["critical_path_bytes"]
+        )
+
+    @property
+    def sequential_model_bytes(self) -> int:
+        """Modeled byte-equivalent time with every stage serialized —
+        the lane-amplified traffic (:attr:`effective_bytes`) plus the
+        overlap groups' reassembly-copy terms the per-step accounting
+        folds into the group model rather than ``bytes_copied``."""
+        extra = 0
+        if self.overlap:
+            group_wire = sum(int(g["wire_bytes"]) for g in self.overlap["groups"])
+            extra = int(self.overlap["sequential_bytes"]) - group_wire
+        return self.effective_bytes + extra
+
     def collective_counts(self) -> Dict[str, int]:
         """{HLO op name: count} the executed program must launch —
         directly comparable with
@@ -200,6 +276,7 @@ class Schedule:
             "collective_counts": self.collective_counts(),
             "within_budget": self.within_budget,
             "notes": self.notes,
+            "overlap": self.overlap,
         }
         if with_plan_id:
             d["plan_id"] = self.plan_id
@@ -215,11 +292,53 @@ class Schedule:
             separators=(",", ":"),
         )
 
+    def describe(self) -> str:
+        """Human-readable rendering of the plan: one line per step with
+        its movement/copy accounting and pipeline tag, plus the overlap
+        annotation's modeled critical-path arithmetic — what
+        ``ht.redistribution.explain(...)`` shows when printed."""
+        groups = {g["tag"]: g for g in (self.overlap or {}).get("groups", [])}
+        lines = [
+            f"plan {self.plan_id}  strategy={self.strategy}  "
+            f"depth={self.overlap_depth}  {self.spec!r}"
+        ]
+        for k, s in enumerate(self.steps):
+            chunk = f"[{s.chunk}]" if s.chunk is not None else ""
+            pipe = f"  pipe={s.overlap}" if s.overlap else ""
+            g = groups.get(s.overlap)
+            if g and s.is_collective:
+                # per-step modeled time under depth-2 pipelining: this
+                # lap's wire overlaps the previous lap's reassembly copy
+                w = g["wire_bytes"] // g["laps"]
+                c = g["copy_bytes"] // g["laps"]
+                model = f"  model=max(wire {w}, copy {c})={max(w, c)} B"
+            else:
+                model = f"  model={s.effective_bytes} B"
+            lines.append(
+                f"  [{k:2d}] {s.kind}{chunk}  moved={s.bytes_moved}  "
+                f"copied={s.bytes_copied}  peak={s.peak_bytes}{pipe}{model}"
+                + (f"  -- {s.detail}" if s.detail else "")
+            )
+        if self.overlap:
+            o = self.overlap
+            lines.append(
+                f"  overlap: depth={o['depth']} groups={len(o['groups'])} "
+                f"critical_path={o['critical_path_bytes']} B vs "
+                f"sequential={o['sequential_bytes']} B "
+                f"(model_speedup={o['model_speedup']}x)"
+            )
+        else:
+            lines.append("  overlap: none (sequential schedule)")
+        if self.notes:
+            lines.append(f"  notes: {self.notes}")
+        return "\n".join(lines)
+
     def __repr__(self) -> str:
         kinds = [
             s.kind + (f"[{s.chunk}]" if s.chunk is not None else "") for s in self.steps
         ]
+        ov = f", overlap=depth{self.overlap_depth}" if self.overlap else ""
         return (
             f"Schedule({self.strategy}, plan={self.plan_id}, {self.spec!r}, "
-            f"steps={kinds}, peak={self.peak_bytes}B/{self.budget_bytes}B)"
+            f"steps={kinds}, peak={self.peak_bytes}B/{self.budget_bytes}B{ov})"
         )

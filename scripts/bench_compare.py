@@ -63,6 +63,13 @@ HIGHER_IS_BETTER = {
     # path's pass-count HBM model (heat_tpu.kernels.sort.sort_plan)
     "vs_jnp_sort",
     "sort_frac",
+    # overlap acceptance fields (ISSUE 6) on the redistribution rows:
+    # `critical_path_model` is the planner's modeled max-vs-sum speedup
+    # of the pipelined stage groups, `vs_sequential` the measured
+    # same-run ratio against the HEAT_TPU_REDIST_OVERLAP=0 twin — both
+    # ride in the compact key_rows so driver artifacts gate them
+    "critical_path_model",
+    "vs_sequential",
 }
 
 # rows that changed name across rounds: a baseline row under the old

@@ -61,6 +61,16 @@ HEAT_TPU_RELAYOUT_KERNEL=1 python -m pytest tests/test_kernels_relayout.py tests
 
 HEAT_TPU_RELAYOUT_KERNEL=0 python -m pytest tests/test_kernels_relayout.py -q "$@"
 
+# overlap legs (ISSUE 6), mirroring the kernel legs: forced software
+# pipelining + collective-matmul ring forms over the redistribution and
+# linalg suites (Pallas-interpret compatible — the packed-pivot programs
+# run their relayout kernels in interpret mode on CPU) (leg 12); and the
+# HEAT_TPU_REDIST_OVERLAP=0 escape hatch, proving the sequential oracle
+# is bit-identical over the same surface (leg 13)
+HEAT_TPU_REDIST_OVERLAP=1 python -m pytest tests/test_overlap.py tests/test_redistribution.py tests/test_linalg.py tests/test_kernels_relayout.py -q "$@"
+
+HEAT_TPU_REDIST_OVERLAP=0 python -m pytest tests/test_overlap.py tests/test_redistribution.py -q "$@"
+
 python scripts/lint.py heat_tpu/
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \

@@ -6,7 +6,13 @@ key the executor's program cache (``plan_id`` = sha1 of the canonical
 serialization), so they must be byte-identical run-to-run — any
 nondeterminism in the planner (dict ordering, float formatting,
 environment leakage) shows up here as a diff before it can show up as a
-phantom cache miss or a flapping golden test.
+phantom cache miss or a flapping golden test. The ISSUE-6 ``overlap``
+annotation (pipe tags per step, per-group critical-path model,
+``model_speedup``) is part of the canonical serialization, so the
+determinism leg covers the annotated plans and their plan_ids — and the
+annotation is gate-independent (``HEAT_TPU_REDIST_OVERLAP`` switches
+the executor's issue order, never the plan), so an ambient gate cannot
+make two runs diverge either.
 
 Pure Python: no mesh, no jax device work — safe on any container.
 """

@@ -56,3 +56,33 @@ def donated_program(x):
     """Clean twin of ``bad_program``'s SL105 arm: same aliasable output,
     but the wrapper donates the argument."""
     return ht.exp(x)
+
+
+def ppermute_ring_program(x):
+    """SL101: a hand-rolled ppermute relayout loop with NO plan stamp —
+    every hop ships the whole local shard around the ring (an all-gather
+    in disguise, (p-1)x the bytes of a planned exchange). The planner's
+    own ring/pipelined programs run under ``redist_plan_<id>`` /
+    ``cmatmul_ring_<tag>`` named scopes and downgrade to info; the
+    accident SL101 exists for is exactly this UNstamped chain."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from heat_tpu.core._jax_compat import shard_map
+
+    comm = x.comm
+    p = comm.size
+    phys = x._phys
+
+    def body(xl):
+        acc = xl
+        for d in range(1, p):
+            acc = lax.ppermute(
+                acc, comm.axis_name, [(s, (s + 1) % p) for s in range(p)]
+            )
+        return acc
+
+    spec = P(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+    return shard_map(
+        body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    )(phys)

@@ -84,6 +84,33 @@ class TestIRCheckBadFixture(TestCase):
         donated = ht.analysis.check(ht.jit(fx.donated_program, donate_argnums=0), x)
         self.assertNotIn("SL105", donated.rule_ids)
 
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_unstamped_ppermute_loop_trips_sl101(self):
+        """ISSUE 6 golden bad-fixture: a hand-rolled ppermute relayout
+        loop with no plan stamp still trips SL101 at full severity —
+        the planner's own pipelined ring programs downgrade to info
+        (tests/test_overlap.py), the UNstamped chain must not."""
+        rep = ht.analysis.check(fx.ppermute_ring_program, _big_split0())
+        hops = [f for f in rep.by_rule("SL101") if f.op == "collective-permute"]
+        self.assertTrue(hops)
+        for f in hops:
+            self.assertIn(f.severity, ("warning", "error"))
+            self.assertGreaterEqual(f.nbytes, 1 << 20)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_library_ring_schedules_report_as_info(self):
+        """The library's OWN documented ring schedules (the distributed
+        sort networks' block rotations here) are not hand-rolled
+        accidents: their collective-permute hops report at info, keyed
+        on the instruction's source_file (boundaries.RING_SCHEDULE_MODULES)."""
+        x = ht.random.randn(P * (1 << 20), split=0)  # MB-class hops
+        rep = ht.analysis.check(lambda v: ht.sort(v)[0], x)
+        hops = [f for f in rep.findings if f.op == "collective-permute"]
+        self.assertTrue(hops)
+        for f in hops:
+            self.assertEqual(f.severity, "info")
+            self.assertIn("ring schedule", f.message)
+
     def test_trace_abort_reports_host_sync_not_raise(self):
         def syncing(v):
             s = ht.sum(v)

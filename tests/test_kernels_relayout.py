@@ -224,10 +224,12 @@ class TestPackedPlans(TestCase):
         self.assertEqual(len(amplified), 1)
 
     def test_tighter_budget_rechunks_packed(self):
+        # the default plan already runs overlap-grain laps (ISSUE 6), so
+        # the budget must tighten past the grain before it adds chunks
         base = planner.plan(self.NARROW, BUDGET)
-        tight = planner.plan(self.NARROW, BUDGET // 2)
+        tight = planner.plan(self.NARROW, BUDGET // 8)
         self.assertLessEqual(
-            max(s.peak_bytes for s in tight.steps if s.is_collective), BUDGET // 2
+            max(s.peak_bytes for s in tight.steps if s.is_collective), BUDGET // 8
         )
         self.assertGreater(
             tight.collective_counts()["all-to-all"],

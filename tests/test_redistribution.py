@@ -48,7 +48,10 @@ GOLDEN_PINS = {
     "replicate_p8": ("replicate", 1, {"all-gather": 1}),
     "slice_from_replicated_p8": ("slice", 1, {}),
     "mesh1_resplit": ("local", 0, {}),
-    "resplit_chunked_2gb_p8": ("chunked-all-to-all", 5, {"all-to-all": 2}),
+    # big exchanges chunk to the OVERLAP_GRAIN (ISSUE 6) so the executor
+    # has laps to double-buffer — the lap structure (and census) is
+    # identical overlap-on and overlap-off
+    "resplit_chunked_2gb_p8": ("chunked-all-to-all", 9, {"all-to-all": 4}),
     "resplit_ring_8gb_p8": ("ring", 7, {"collective-permute": 7}),
     # narrow minor dims (40->80 over p=8: 5- and 10-lane shards): the
     # lane-fill cost term picks the packed pivot
@@ -56,13 +59,15 @@ GOLDEN_PINS = {
     "reshape_split0_local_p8": ("local-reshape", 1, {}),
     "reshape_gather_fallback_p8": ("gather-reshape", 3, {"all-gather": 1}),
     # the 1 GB ROADMAP spec: packed on the narrow OUT side (25->32 cols,
-    # 4-lane shards); same all-to-all census as the direct pivot
-    "reshape_split1_1gb_p8": ("packed-pivot", 9, {"all-to-all": 3}),
+    # 4-lane shards); same all-to-all census as the direct pivot. 5 in-
+    # laps (125 MB over the 32 MiB overlap grain, divisor-rounded) and 4
+    # out-laps (160 MB)
+    "reshape_split1_1gb_p8": ("packed-pivot", 23, {"all-to-all": 9}),
     # its reverse: packed on the narrow IN side
-    "reshape_packed_rev_p8": ("packed-pivot", 8, {"all-to-all": 3}),
+    "reshape_packed_rev_p8": ("packed-pivot", 22, {"all-to-all": 9}),
     # lane-friendly companion (512/256-lane shards): packing gains
-    # nothing, the DIRECT pivot stays
-    "reshape_lane_1gb_p8": ("split0-pivot", 3, {"all-to-all": 2}),
+    # nothing, the DIRECT pivot stays; 4 overlap laps per side
+    "reshape_lane_1gb_p8": ("split0-pivot", 19, {"all-to-all": 8}),
 }
 
 
@@ -70,20 +75,24 @@ def _golden():
     return planner.golden_specs()
 
 
-def _planner_program(comm, spec, budget):
+def _planner_program(comm, spec, budget, pipelined=False):
     """The jitted program the executor would run for ``spec``, or None
-    for the direct-placement strategies (noop/local/slice/replicate)."""
+    for the direct-placement strategies (noop/local/slice/replicate).
+    ``pipelined`` selects the ISSUE-6 software-pipelined issue order of
+    the chunk loops (same collectives; tests pin both forms)."""
     strategy = planner.plan(spec, budget).strategy
     if strategy in ("noop", "local", "slice", "replicate"):
         return None
     if strategy in ("all-to-all", "chunked-all-to-all", "ring"):
-        return executor._move_program(comm, spec, budget)
+        return executor._move_program(comm, spec, budget, pipelined)
     if strategy == "split0-pivot":
-        return executor._pivot_program(comm, spec, budget)
+        return executor._pivot_program(comm, spec, budget, pipelined)
     if strategy == "packed-pivot":
         sched = planner.plan(spec, budget)
         impl_in, impl_out = executor._relayout_impls(spec, sched)
-        return executor._packed_pivot_program(comm, spec, budget, impl_in, impl_out)
+        return executor._packed_pivot_program(
+            comm, spec, budget, impl_in, impl_out, pipelined
+        )
     if strategy == "gather-reshape":
         return executor._gather_reshape_program(comm, spec, budget)
     return executor._local_reshape_program(comm, spec, budget)
@@ -130,16 +139,17 @@ class TestGoldenPlans(TestCase):
         self.assertEqual(sched.collective_counts().get("all-gather", 0), 0)
 
     def test_tighter_budget_rechunks(self):
-        """Halving the budget must re-chunk, not blow the budget: the
-        2 GiB resplit pipelines into more laps and the peak drops."""
+        """Tightening the budget must re-chunk, not blow the budget: the
+        2 GiB resplit pipelines into more laps and the peak drops. (The
+        default plan already runs 4 overlap-grain laps, so the budget
+        must drop past that point before it binds — BUDGET//4 forces 8.)"""
         (spec,) = [s for n, s in _golden() if n == "resplit_chunked_2gb_p8"]
         base = planner.plan(spec, BUDGET)
-        tight = planner.plan(spec, BUDGET // 2)
-        self.assertLessEqual(tight.peak_bytes, BUDGET // 2)
-        self.assertGreater(
-            tight.collective_counts()["all-to-all"],
-            base.collective_counts()["all-to-all"],
-        )
+        tight = planner.plan(spec, BUDGET // 4)
+        self.assertLessEqual(tight.peak_bytes, BUDGET // 4)
+        # the tighter plan pipelines more collectives (chunk laps, or the
+        # p-1 ppermute hops of the minimal-footprint ring)
+        self.assertGreater(tight.n_collectives, base.n_collectives)
 
     def test_plan_cache_and_telemetry(self):
         from heat_tpu.observability import telemetry

@@ -59,6 +59,22 @@ u, s, v, err = ht.linalg.hsvd_rank(ht.array(lr, split=0), 8, compute_sv=True)
 rec = (u.numpy() * s.numpy()) @ v.numpy().T
 assert np.linalg.norm(rec - lr) / np.linalg.norm(lr) < 1e-3
 
+# collective-matmul form (ISSUE 6): BOTH tree levels decompose into
+# grouped ppermute rings — (s-1) + (G-1) = 6 hops, zero all-gathers —
+# and Q/R stay bit-identical to the barrier form (the rings assemble
+# the identical stacked R arrays)
+fn_ring = _tsqr_fn(comm.mesh, comm.axis_name, 40, 24, 'float32', True, ring=True)
+txt_r = fn_ring.lower(phys).compile().as_text()
+assert ' all-gather(' not in txt_r and 'all-gather-start(' not in txt_r
+n_cp = txt_r.count(' collective-permute(') + txt_r.count('collective-permute-start(')
+assert n_cp == (s_w - 1) + (G_w - 1), n_cp
+a = rng.standard_normal((16 * 40, 24)).astype(np.float32)
+pa = comm.shard(jnp.asarray(a), 0)
+qg, rg = fn(pa)
+qr_, rr_ = fn_ring(pa)
+assert (np.asarray(qg) == np.asarray(qr_)).all()
+assert (np.asarray(rg) == np.asarray(rr_)).all()
+
 print('TSQR_TWO_LEVEL_OK')
 """
 
