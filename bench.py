@@ -800,6 +800,16 @@ def measure_heat_tpu() -> dict:
             # the acceptance field: modeled sequential/critical-path
             # ratio of the pipelined stage groups (max-vs-sum arithmetic)
             f["critical_path_model"] = plan.overlap["model_speedup"]
+        # wire-codec accounting (ISSUE 7): raw vs actually-shipped bytes
+        # of the executing plan (quantized under HEAT_TPU_WIRE_QUANT —
+        # auto engages int8 on TPU; wire_ratio 1.0 = full-width wire).
+        # The acceptance gate is wire_ratio <= 0.5 on the int8 rows.
+        raw, sent = plan.wire_bytes_raw, plan.wire_bytes_sent
+        f["wire_bytes_raw"] = raw
+        f["wire_bytes_sent"] = sent
+        f["wire_ratio"] = round(sent / raw, 4) if raw else 1.0
+        if plan.quant:
+            f["quant"] = plan.quant["mode"]
         return f
 
     # reshape there-and-back per step = 2 ops; slope halved. The legacy
@@ -1351,6 +1361,34 @@ def main() -> None:
         if ratio is not None:
             detail[row]["vs_sequential"] = round(ratio, 3)
 
+    # dp_step_quant (ISSUE 7): the analytic v5e-64 quantized-gradient
+    # row — no DP mesh is attached, so the row IS the checkable model
+    # (the MULTICHIP_*.json convention): a 100M-param f32 ICI-bound
+    # layer (1 ms compute vs ~3.94 ms psum wire at 200 GB/s/chip) under
+    # the int8 codec. `dp_model_speedup` and `wire_ratio` are gated by
+    # scripts/bench_compare.py; tests pin >= 1.5x.
+    try:
+        from heat_tpu.kernels import quant as _wire_quant
+
+        _dpm = _wire_quant.dp_step_model(
+            400_000_000, compute_s=1e-3, p=64, mode="int8"
+        )
+        detail["dp_step_quant"] = {
+            "modeled": True,
+            "param_bytes": _dpm["param_bytes"],
+            "compute_ms": 1.0,
+            "wire_ms_raw": round(_dpm["wire_s_raw"] * 1e3, 3),
+            "wire_ms_quant": round(_dpm["wire_s_quant"] * 1e3, 3),
+            "dp_model_speedup": _dpm["model_speedup"],
+            "wire_ratio": _dpm["wire_ratio"],
+            "method": (
+                "analytic v5e-64 model (kernels.quant.dp_step_model; "
+                "no DP mesh attached)"
+            ),
+        }
+    except Exception:  # pragma: no cover — the model must never take bench down
+        pass
+
     # chip rows
     mfu("matmul_bf16_8k", 2 * MM_8K**3)
     mfu("matmul_f32_8k", 2 * MM_8K**3)
@@ -1552,19 +1590,26 @@ def main() -> None:
             # the ROADMAP reshape acceptance fields (ISSUE 5) + the
             # ISSUE 6 overlap fields (`critical_path_model` = modeled
             # max-vs-sum speedup, `vs_sequential` = measured same-run
-            # ratio): in the driver artifact so future rounds gate on them
+            # ratio) + the ISSUE 7 `wire_ratio` (encoded/raw wire bytes
+            # of the executing plan — the <= 0.5 acceptance gate): in
+            # the driver artifact so future rounds gate on them
             "reshape_split1_1gb": pick(
                 "reshape_split1_1gb", "hbm_frac", "path", "critical_path_model",
-                "vs_sequential", "measurement_suspect",
+                "vs_sequential", "wire_ratio", "measurement_suspect",
             ),
             "reshape_lane_1gb": (
                 pick("reshape_lane_1gb", "hbm_frac", "path", "critical_path_model",
-                     "vs_sequential", "measurement_suspect")
+                     "vs_sequential", "wire_ratio", "measurement_suspect")
                 if "reshape_lane_1gb" in detail else {}
             ),
             "resplit_1gb": pick(
                 "resplit_1gb", "hbm_frac", "path", "critical_path_model",
-                "vs_sequential", "measurement_suspect",
+                "vs_sequential", "wire_ratio", "measurement_suspect",
+            ),
+            # ISSUE 7 analytic DP row (modeled, gated)
+            "dp_step_quant": (
+                pick("dp_step_quant", "dp_model_speedup", "wire_ratio")
+                if "dp_step_quant" in detail else {}
             ),
             "op_chain": pick("op_chain", "overhead_vs_raw_jnp", "overhead_vs_fused_jnp"),
             "ht_jit_chain": pick("ht_jit_chain", "overhead_vs_fused_jnp") if "ht_jit_chain" in detail else {},

@@ -41,9 +41,11 @@ __all__ = [
     "HOST_BOUNDARIES",
     "PLANNER_MODULES",
     "RING_SCHEDULE_MODULES",
+    "WIRE_CODEC_MARKER",
     "is_declared_sync",
     "planned_reshard_plan_id",
     "ring_schedule_module",
+    "wire_codec_stamped",
 ]
 
 # modules that are host I/O by contract (posix path suffixes)
@@ -167,6 +169,22 @@ def planned_reshard_plan_id(hlo_line: str) -> Optional[str]:
         return m.group(1)
     m = _CMATMUL_MARKER.search(hlo_line)
     return f"cmatmul:{m.group(1)}" if m else None
+
+
+# The wire codec (kernels/quant.py) wraps every encode/decode body in
+# jax.named_scope("wire_codec_<mode>"); the stamp rides each traced
+# eqn's name_stack the same way the executor's redist_plan scopes ride
+# the HLO op_name. SL104's narrowing arm keys on it: a STAMPED
+# float->int8 convert before a collective is the sanctioned
+# block-quantized payload (info), an unstamped one is the
+# gradient-compression accident the rule exists for (error —
+# golden bad-fixture ``tests/analysis_fixtures.int8_wire_program``).
+WIRE_CODEC_MARKER = "wire_codec_"
+
+
+def wire_codec_stamped(name_stack: str) -> bool:
+    """Does a traced eqn's name_stack carry the wire-codec stamp?"""
+    return WIRE_CODEC_MARKER in name_stack
 
 
 # Modules whose ppermute chains are DOCUMENTED ring schedules — the

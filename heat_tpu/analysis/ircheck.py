@@ -22,7 +22,12 @@ SL102     warn/err  an all-gather materializes ≥ ``min_bytes`` (same
                     escalation — a full-operand gather is an error)
 SL103     warning   an all-gather result feeds a ``reduce``
 SL104     warning   an inexact value widens past core/types.py
-                    promotion of the program inputs
+                    promotion of the program inputs; its NARROWING arm
+                    (error) fires when an unscaled float→int8 cast
+                    feeds a collective — the sanctioned dtype narrowing
+                    is the stamped block-quantized wire codec
+                    (``heat_tpu.kernels.quant``), which downgrades to
+                    info
 SL105     warning   an output aliases an argument's aval but the buffer
                     is not donated (cross-checked against ht.jit's
                     donation bookkeeping)
@@ -366,6 +371,128 @@ def check(
                     op="convert_element_type",
                 )
             )
+
+    # ---- SL104 (narrowing arm): float->int8 feeding a collective -------
+    # an UNSCALED astype(int8) before a psum/all-to-all truncates the
+    # payload and wraps the reduction — the accident gradient
+    # compression invites. The sanctioned narrowing is the
+    # block-quantized wire codec (kernels/quant.py), whose encode/decode
+    # bodies run under jax.named_scope("wire_codec_<mode>"): the stamp
+    # rides the eqn's name_stack, and stamped converts report at info.
+    from .boundaries import wire_codec_stamped
+
+    from jax.extend import core as jex_core
+
+    collective_prims = {
+        "psum", "all_to_all", "all_gather", "ppermute", "pmax", "pmin",
+        "psum_scatter", "reduce_scatter",
+    }
+    passthrough_prims = {
+        "concatenate", "reshape", "transpose", "squeeze", "broadcast_in_dim",
+        "slice", "dynamic_slice", "pad", "rev", "select_n", "copy",
+        # jnp.where/clip/round wrap their select/round bodies in nested
+        # pjit eqns: the outer walk continues through the pjit's OWN
+        # invars (the operands), which is exactly the dataflow step
+        "pjit", "custom_jvp_call", "custom_vjp_call",
+    }
+    int8_dts = (np.dtype(np.int8), np.dtype(np.uint8))
+    seen_narrow = set()
+    # ONE producer map over every (sub-)jaxpr: vars are unique objects,
+    # so the map lets the backward walk cross call boundaries — a
+    # convert hiding inside a nested pjit is reached by stepping from
+    # the pjit eqn onto its sub-jaxpr's OUTVARS (the value the outer
+    # program actually consumes), not just its outer operands.
+    producers = {}
+    collective_eqns = []
+    todo_jx, seen_jx = [closed.jaxpr], set()
+    while todo_jx:
+        jx = todo_jx.pop()
+        if id(jx) in seen_jx:
+            continue
+        seen_jx.add(id(jx))
+        for eqn in jx.eqns:
+            for ov in eqn.outvars:
+                producers[id(ov)] = eqn
+            if eqn.primitive.name in collective_prims:
+                collective_eqns.append(eqn)
+            for val in eqn.params.values():
+                todo_jx.extend(_as_jaxprs(val, jex_core))
+
+    def _sub_outvar_for(eqn, v):
+        """The sub-jaxpr outvar that PRODUCES the outer var ``v`` of a
+        call eqn (pjit/custom_*): call outvars map 1:1 onto the
+        sub-jaxpr's outvars by position, so only the index-matched one
+        continues the walk — a sibling output of the same jit wrapper
+        is not on the collective's dataflow path."""
+        try:
+            idx = next(i for i, ov in enumerate(eqn.outvars) if ov is v)
+        except StopIteration:
+            return []
+        out = []
+        for val in eqn.params.values():
+            for sub in _as_jaxprs(val, jex_core):
+                outvars = getattr(sub, "jaxpr", sub).outvars
+                if idx < len(outvars):
+                    out.append(outvars[idx])
+        return out
+
+    for eqn in collective_eqns:
+        stack = [(v, 0) for v in eqn.invars]
+        visited = set()
+        while stack:
+            v, depth = stack.pop()
+            if depth > 12 or isinstance(v, jex_core.Literal) or id(v) in visited:
+                continue
+            visited.add(id(v))
+            src = producers.get(id(v))
+            if src is None:
+                continue
+            name = src.primitive.name
+            if name == "convert_element_type":
+                src_dt = np.dtype(src.invars[0].aval.dtype)
+                dst_dt = np.dtype(src.params.get("new_dtype"))
+                if src_dt.kind in "fc" and dst_dt in int8_dts:
+                    stamped = wire_codec_stamped(str(src.source_info.name_stack))
+                    dkey = (src_dt.name, dst_dt.name, eqn.primitive.name, stamped)
+                    if dkey in seen_narrow:
+                        continue
+                    seen_narrow.add(dkey)
+                    if stamped:
+                        findings.append(
+                            Finding(
+                                "SL104",
+                                "info",
+                                f"sanctioned wire-codec narrowing: {src_dt.name} "
+                                f"-> {dst_dt.name} feeds a {eqn.primitive.name} "
+                                "inside a wire_codec-stamped encode "
+                                "(heat_tpu.kernels.quant) — the block-quantized "
+                                "collective payload, scale per tile",
+                                op="convert_element_type",
+                            )
+                        )
+                    else:
+                        findings.append(
+                            Finding(
+                                "SL104",
+                                "error",
+                                f"lossy dtype narrowing {src_dt.name} -> "
+                                f"{dst_dt.name} feeds a {eqn.primitive.name}: an "
+                                "unscaled astype before a collective truncates "
+                                "the payload (int8 sums wrap) — use the "
+                                "block-quantized wire codec "
+                                "(heat_tpu.kernels.quant) or ship full width",
+                                op="convert_element_type",
+                            )
+                        )
+                continue  # a convert ends the walk either way
+            if name in passthrough_prims:
+                stack.extend((u, depth + 1) for u in src.invars)
+                # a call primitive's RESULT is produced by its
+                # sub-jaxpr's outvars: step inside (index-matched) so a
+                # convert hiding in a nested jit wrapper is reached,
+                # while the wrapper's unrelated sibling outputs are not
+                if name in ("pjit", "custom_jvp_call", "custom_vjp_call"):
+                    stack.extend((u, depth + 1) for u in _sub_outvar_for(src, v))
 
     # ---- SL105: aliasable output not donated ---------------------------
     # with explicit donation bookkeeping the per-aval check below is the

@@ -9,17 +9,25 @@ module — the local radix/columnsort sort engines feeding both
 local-sort steps (``core/parallel.py``), the lane-packing relayout
 copies under the redistribution planner (``relayout``), and the
 ppermute-ring collective-matmul primitives the TSQR merge and split
-matmul overlap their compute with (``cmatmul``). Every kernel here
-ships with capability gates, a numerical oracle as the fallback, and an
-environment escape hatch.
+matmul overlap their compute with (``cmatmul``), and the
+block-quantized wire codec the redistribution executor and the DP
+optimizer ship collective payloads through (``quant``). Every kernel
+here ships with capability gates, a numerical oracle as the fallback,
+and an environment escape hatch.
 """
 
 from . import cmatmul
+from . import quant
 from . import relayout
 from . import sort
 from .cmatmul import (
     ring_all_gather,
     ring_matmul_reduce,
+)
+from .quant import (
+    decode_blocks,
+    encode_blocks,
+    wire_ratio,
 )
 from .relayout import (
     lane_fill,
@@ -36,9 +44,12 @@ from .sort import (
 
 __all__ = [
     "cmatmul",
+    "quant",
     "relayout",
     "sort",
     "block_sort",
+    "decode_blocks",
+    "encode_blocks",
     "from_sortable",
     "lane_fill",
     "local_sort",
@@ -48,4 +59,5 @@ __all__ = [
     "sort_plan",
     "to_sortable",
     "unpack_rows",
+    "wire_ratio",
 ]

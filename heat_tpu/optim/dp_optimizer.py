@@ -10,6 +10,18 @@ Replaces /root/reference/heat/optim/dp_optimizer.py:
   loss lowers to one fused all-reduce over the mesh) and the optimizer
   update. Blocking vs non-blocking is moot — XLA overlaps the collective
   with compute.
+- Quantized-gradient DP (ISSUE 7, opt-in ``wire_quant="int8"/"bf16"``):
+  the gradient all-reduce decomposes into the block-quantized wire form
+  of ``heat_tpu.kernels.quant`` — quantize the local contribution (plus
+  the error-feedback carry), ship int8 blocks through ONE all-to-all
+  (the reduce-scatter leg: each device decodes and sums the p partials
+  of its block full-width) and ONE all-gather of the re-encoded reduced
+  blocks, then dequantize. Wire bytes drop to ``wire_ratio`` (~0.25
+  int8 / 0.5 bf16) of the psum's, which on the analytic v5e-64 model
+  converts ≥1.5× of step time on ICI-bound layers
+  (``kernels.quant.dp_step_model``); the per-device error-feedback
+  carry re-injects the compression error next step, so the long-run
+  gradient is unbiased (EQuARX, arXiv:2506.17615).
 - ``DASO`` (reference :64-850): hierarchical/asynchronous DP. The
   reference runs node-local torch-DDP every batch and staggers global MPI
   syncs across "skip batches" with bf16-compressed buffers and custom MPI
@@ -147,22 +159,42 @@ class DataParallelOptimizer:
         Reference API parity; both values run the same fused step (the
         blocking/non-blocking distinction is the reference's hook
         choreography, data_parallel.py:219-295, which XLA makes obsolete).
+    wire_quant : {"int8", "bf16"}, optional
+        Opt-in quantized-gradient mode: the gradient all-reduce ships
+        block-quantized payloads (``heat_tpu.kernels.quant``, scale per
+        1024-element tile) with a per-device error-feedback carry. The
+        default ``None`` keeps the exact full-width psum — this mode is
+        a constructor decision, never an ambient env flip, because it
+        changes training numerics (within the codec's pinned tolerance
+        per step; EF makes the long-run gradient unbiased).
     """
 
-    def __init__(self, local_optimizer, model, loss=None, blocking: bool = True):
+    def __init__(self, local_optimizer, model, loss=None, blocking: bool = True,
+                 wire_quant: Optional[str] = None):
         if not isinstance(local_optimizer, LocalOptimizer):
             raise TypeError(
                 f"local_optimizer must be a heat_tpu.optim optimizer, got {type(local_optimizer)}"
             )
+        if wire_quant is not None:
+            from ..kernels.quant import MODES
+
+            if wire_quant not in MODES:
+                raise ValueError(
+                    f"wire_quant must be one of {MODES} or None, got {wire_quant!r}"
+                )
         self.model = model
         self.tx = local_optimizer.tx
         self.loss = loss if loss is not None else CrossEntropyLoss()
         self.blocking = bool(blocking)
+        self.wire_quant = wire_quant
         repl = model.comm.sharding(0, None)
         self.opt_state = jax.device_put(self.tx.init(model.params), repl)
         self._iter = 0
         self._base_key = jax.random.PRNGKey(0)
         self._step_cache = {}
+        # per-device error-feedback carry (quantized mode only), built
+        # lazily once the flat gradient size is known
+        self._ef_carry = None
 
     # -------------------------------------------------------------- #
     def zero_grad(self) -> None:
@@ -206,12 +238,94 @@ class DataParallelOptimizer:
         self._step_cache[key] = fn
         return fn
 
+    # -------------------------------------------------------------- #
+    # quantized-gradient mode (ISSUE 7)                               #
+    # -------------------------------------------------------------- #
+    def _flat_param_count(self) -> int:
+        from jax.flatten_util import ravel_pytree
+
+        return int(ravel_pytree(self.model.params)[0].size)
+
+    def _init_ef_carry(self):
+        """Zero per-device error-feedback residuals: one flat gradient
+        vector per device, leading axis sharded over the mesh."""
+        comm = self.model.comm
+        n = self._flat_param_count()
+        self._ef_carry = jax.device_put(
+            jnp.zeros((comm.size, n), jnp.float32), comm.sharding(2, 0)
+        )
+
+    def _get_quant_step(self, xshape, xdtype, yshape, ydtype, n_valid: int):
+        key = (xshape, xdtype, yshape, ydtype, n_valid, self.wire_quant)
+        fn = self._step_cache.get(key)
+        if fn is not None:
+            return fn
+        module, loss, tx = self.model.module, self.loss, self.tx
+        comm = self.model.comm
+        p, axis = comm.size, comm.axis_name
+        mode = self.wire_quant
+        import optax
+
+        from jax.flatten_util import ravel_pytree
+        from ..kernels import quant as _quant
+
+        blk_rows = xshape[0] // p
+
+        def blk(params, opt_state, carry_blk, xb, yb, dropkey):
+            dev = jax.lax.axis_index(axis)
+            rows = dev * blk_rows + jnp.arange(blk_rows)
+            w = (rows < n_valid).astype(xb.dtype)
+
+            def local_sums(pp):
+                out = module.apply(
+                    pp, xb, train=True, key=jax.random.fold_in(dropkey, dev)
+                )
+                # loss contract (see DASO): raw() is the weighted MEAN;
+                # x Σw recovers the weighted sum this wire reduces over
+                return loss.raw(out, yb, weight=w) * jnp.sum(w)
+
+            sum_loss, g = jax.value_and_grad(local_sums)(params)
+            g_flat, unravel = ravel_pytree(g)
+            # error feedback: re-inject last step's compression residual,
+            # ship the compensated gradient through the quantized wire
+            h = g_flat.astype(jnp.float32) + carry_blk[0]
+            red, resid = _quant.quantized_allreduce_sum(h, axis, p, mode)
+            wsum = jax.lax.psum(jnp.sum(w), axis)
+            gbar = unravel((red / jnp.maximum(wsum, 1.0)).astype(g_flat.dtype))
+            updates, o2 = tx.update(gbar, opt_state, params)
+            p2 = optax.apply_updates(params, updates)
+            gl = jax.lax.psum(sum_loss, axis) / jnp.maximum(wsum, 1.0)
+            return p2, o2, resid[None], gl
+
+        mapped = shard_map(
+            blk,
+            mesh=comm.mesh,
+            in_specs=(P(), P(), P(axis), P(axis), P(axis), P()),
+            out_specs=(P(), P(), P(axis), P()),
+            check_vma=False,
+        )
+        fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
+        self._step_cache[key] = fn
+        return fn
+
     def step(self, x: DNDarray, y: DNDarray) -> DNDarray:
         """One fused train step on a global batch; returns the global-mean
         loss as a 0-d replicated DNDarray (no host sync)."""
         xb, yb = x._phys, _aligned_labels(x, y)
         self._iter += 1
         dropkey = jax.random.fold_in(self._base_key, self._iter)
+        if self.wire_quant is not None and self.model.comm.size > 1:
+            if self._ef_carry is None:
+                self._init_ef_carry()
+            fn = self._get_quant_step(
+                tuple(xb.shape), str(xb.dtype), tuple(yb.shape), str(yb.dtype),
+                x.shape[0],
+            )
+            params, self.opt_state, self._ef_carry, loss_val = fn(
+                self.model.params, self.opt_state, self._ef_carry, xb, yb, dropkey
+            )
+            self.model.params = params
+            return _loss_scalar(loss_val, self.model.comm, x.device)
         fn = self._get_step(
             tuple(xb.shape), str(xb.dtype), tuple(yb.shape), str(yb.dtype), x.shape[0]
         )

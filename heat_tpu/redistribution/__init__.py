@@ -30,7 +30,12 @@ is the ``HEAT_TPU_REDIST_BUDGET_MB`` env knob;
 ``HEAT_TPU_REDIST_PLANNER=0`` restores the legacy one-collective paths;
 ``HEAT_TPU_REDIST_OVERLAP=0/1/auto`` switches the executor between the
 sequential oracle and the software-pipelined program forms (same plans,
-same census, bit-identical results).
+same census, bit-identical results);
+``HEAT_TPU_WIRE_QUANT=0/1/bf16/auto`` gates the block-quantized wire
+codec (``heat_tpu.kernels.quant``) — admissible collective groups ship
+int8/bf16 payloads as ``quantize``/``dequantize`` plan steps at a
+pinned numerics tolerance, same census, wire bytes ~quartered (int8) or
+halved (bf16); ``=0`` (and every non-admissible path) is exact-bit.
 """
 
 from . import executor
@@ -47,6 +52,8 @@ from .planner import (
     overlap_mode,
     plan,
     planner_enabled,
+    wire_quant_gate,
+    wire_quant_mode,
 )
 from .schedule import Schedule, Step
 from .spec import RedistSpec
@@ -65,4 +72,6 @@ __all__ = [
     "planner_enabled",
     "reshape_phys",
     "resplit_phys",
+    "wire_quant_gate",
+    "wire_quant_mode",
 ]

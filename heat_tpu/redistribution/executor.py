@@ -29,6 +29,18 @@ gate (``_overlap_active``) and baked into the program cache key. Both
 forms launch identical collectives and write identical (disjoint)
 regions: census and numerics are bit-identical, pinned by
 ``tests/test_overlap.py``.
+
+Wire quantization (ISSUE 7): when the plan carries ``quantize``/
+``dequantize`` codec steps (``HEAT_TPU_WIRE_QUANT``), the same
+chunk/hop loops ship encoded int8/bf16 payloads
+(``heat_tpu.kernels.quant``): ``issue`` encodes the lap's
+per-destination blocks and launches the SAME collective on the wire
+buffer, ``consume`` decodes and scatters — so in the pipelined form
+the dequantize copy rides under the next chunk's wire exactly like the
+reassembly copy it replaces. The codec choice is part of every program
+cache key (a gate flip rebuilds, never reuses), the census is
+unchanged by construction, and with no codec the code paths are
+byte-for-byte the PR 6 forms (the ``=0`` escape hatch is exact-bit).
 """
 
 from __future__ import annotations
@@ -126,9 +138,44 @@ def _run_laps(indices, issue, consume, state, pipelined: bool):
     return consume(state, prev, idx[-1])
 
 
+def _quant_flags(sched: Schedule) -> Tuple[Optional[str], bool, bool]:
+    """(mode, quant_in, quant_out): which collective groups of the plan
+    run on encoded wire payloads, re-derived from step KINDS around the
+    plan's ``reshape`` step (the executor/plan-cannot-disagree rule the
+    chunk counts and packed flags already follow). A move/ring plan has
+    no reshape step: its codec steps all land in ``quant_in``."""
+    mode = sched.quant["mode"] if sched.quant else None
+    seen_reshape = False
+    qin = qout = False
+    for st in sched.steps:
+        if st.kind == "reshape":
+            seen_reshape = True
+        elif st.kind == "quantize":
+            if seen_reshape:
+                qout = True
+            else:
+                qin = True
+    return mode, qin, qout
+
+
+def _wire_a2a_blocks(chunk, axis_name: str, p: int, s_ax: int, codec: str):
+    """The codec form of one tiled all-to-all lap: split ``chunk`` into
+    its p per-destination blocks along ``s_ax``, encode each block as
+    one wire row, and launch the SAME single all-to-all on the int8
+    buffer. Returns the raw received wire rows — the caller decodes in
+    ``consume`` so the full-width write rides under the next lap's
+    collective in the pipelined form."""
+    from ..kernels import quant as _quant
+
+    m = jnp.moveaxis(chunk, s_ax, 0)
+    blocks = m.reshape(p, -1)
+    wire = _quant.encode_blocks(blocks, codec)
+    return lax.all_to_all(wire, axis_name, 0, 0, tiled=True)
+
+
 def _chunked_all_to_all(
     x, axis_name: str, p: int, split_axis: int, concat_axis: int, C: int,
-    pipelined: bool = False,
+    pipelined: bool = False, codec: Optional[str] = None,
 ):
     """Tiled all-to-all in C equal chunks along the concat axis, chunk
     results scattered (in place) into the destination-layout buffer.
@@ -146,26 +193,63 @@ def _chunked_all_to_all(
       scatter lap c — the received chunk's relayout copy runs while the
       next chunk is on the wire (the ``nn/attention.py`` ring trick
       applied to the chunk pipeline; XLA's async collective pair
-      brackets the independent copy work)."""
-    if C <= 1:
-        return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
+      brackets the independent copy work).
+
+    ``codec`` (ISSUE 7) switches every lap onto the encoded wire:
+    ``issue`` packs the lap's p destination blocks through
+    ``kernels.quant.encode_blocks`` and launches ONE all-to-all on the
+    int8 buffer (census unchanged); ``consume`` decodes and scatters,
+    so the full-width dequantize write sits in the consume slot and
+    rides under the next lap's wire when pipelined. ``codec=None`` is
+    byte-for-byte the PR 6 program form."""
+    if codec is None:
+        if C <= 1:
+            return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
+    from ..kernels import quant as _quant  # noqa: F401 (codec path only)
+
     x2 = jnp.moveaxis(x, concat_axis, 0)
     s_ax = split_axis + 1 if split_axis < concat_axis else split_axis
     Bc = x2.shape[0]
+    C = max(C, 1)
     step = Bc // C
     out_shape = (Bc * p,) + tuple(
         d // p if k + 1 == s_ax else d for k, d in enumerate(x2.shape[1:])
     )
 
-    def issue(c):
-        chunk = lax.slice_in_dim(x2, c * step, (c + 1) * step, axis=0)
-        return lax.all_to_all(chunk, axis_name, s_ax, 0, tiled=True)  # (p*step, ...)
+    if codec is None:
 
-    def consume(out, r, c):
-        for s in range(p):
-            piece = lax.slice_in_dim(r, s * step, (s + 1) * step, axis=0)
-            out = lax.dynamic_update_slice_in_dim(out, piece, s * Bc + c * step, axis=0)
-        return out
+        def issue(c):
+            chunk = lax.slice_in_dim(x2, c * step, (c + 1) * step, axis=0)
+            return lax.all_to_all(chunk, axis_name, s_ax, 0, tiled=True)  # (p*step, ...)
+
+        def consume(out, r, c):
+            for s in range(p):
+                piece = lax.slice_in_dim(r, s * step, (s + 1) * step, axis=0)
+                out = lax.dynamic_update_slice_in_dim(
+                    out, piece, s * Bc + c * step, axis=0
+                )
+            return out
+
+    else:
+        S = x2.shape[s_ax]
+        rest = tuple(x2.shape[1:s_ax]) + tuple(x2.shape[s_ax + 1 :])
+        part_m_shape = (S // p, step) + rest
+        n_loc = (S // p) * step
+        for d in rest:
+            n_loc *= d
+
+        def issue(c):
+            chunk = lax.slice_in_dim(x2, c * step, (c + 1) * step, axis=0)
+            return _wire_a2a_blocks(chunk, axis_name, p, s_ax, codec)
+
+        def consume(out, w, c):
+            dec = _quant.decode_blocks(w, n_loc, codec).astype(x.dtype)
+            for q in range(p):
+                part = jnp.moveaxis(dec[q].reshape(part_m_shape), 0, s_ax)
+                out = lax.dynamic_update_slice_in_dim(
+                    out, part, q * Bc + c * step, axis=0
+                )
+            return out
 
     out = _run_laps(range(C), issue, consume, jnp.zeros(out_shape, x.dtype), pipelined)
     return jnp.moveaxis(out, 0, concat_axis)
@@ -187,39 +271,66 @@ def _packed_flags(sched: Schedule) -> Tuple[bool, bool]:
     return packed_in, packed_out
 
 
-def _chunked_a2a_flat(x, axis_name: str, p: int, C: int, pipelined: bool = False):
+def _chunked_a2a_flat(
+    x, axis_name: str, p: int, C: int, pipelined: bool = False,
+    codec: Optional[str] = None,
+):
     """Tiled all-to-all of a ``(p, M)`` column-grouped FLAT buffer
     (``kernels.relayout.pack_rows`` layout): row d is the block bound
     for device d; the result's row q is the block received from device
     q. Both faces are lane-full wide buffers — the packed pivot's
     collective form. ``C > 1`` chunks equal column laps (C | M);
     ``pipelined`` prefetch-issues lap c+1 before placing lap c (same
-    issue-order contract as :func:`_chunked_all_to_all`)."""
-    if C <= 1:
-        return lax.all_to_all(x, axis_name, 0, 0, tiled=True)
+    issue-order contract as :func:`_chunked_all_to_all`). ``codec``
+    ships each lap's rows encoded (the buffer is already
+    destination-major, so the wire rows ARE its rows); the decode sits
+    in the consume slot."""
+    if codec is None:
+        if C <= 1:
+            return lax.all_to_all(x, axis_name, 0, 0, tiled=True)
+    from ..kernels import quant as _quant  # noqa: F401 (codec path only)
+
     M = x.shape[1]
+    C = max(C, 1)
     step = M // C
 
-    def issue(c):
-        chunk = lax.slice_in_dim(x, c * step, (c + 1) * step, axis=1)
-        return lax.all_to_all(chunk, axis_name, 0, 0, tiled=True)
+    if codec is None:
 
-    def consume(out, r, c):
-        return lax.dynamic_update_slice_in_dim(out, r, c * step, axis=1)
+        def issue(c):
+            chunk = lax.slice_in_dim(x, c * step, (c + 1) * step, axis=1)
+            return lax.all_to_all(chunk, axis_name, 0, 0, tiled=True)
+
+        def consume(out, r, c):
+            return lax.dynamic_update_slice_in_dim(out, r, c * step, axis=1)
+
+    else:
+
+        def issue(c):
+            chunk = lax.slice_in_dim(x, c * step, (c + 1) * step, axis=1)
+            wire = _quant.encode_blocks(chunk, codec)
+            return lax.all_to_all(wire, axis_name, 0, 0, tiled=True)
+
+        def consume(out, w, c):
+            dec = _quant.decode_blocks(w, step, codec).astype(x.dtype)
+            return lax.dynamic_update_slice_in_dim(out, dec, c * step, axis=1)
 
     return _run_laps(range(C), issue, consume, jnp.zeros_like(x), pipelined)
 
 
 def _ring_exchange(
     x, axis_name: str, p: int, split_axis: int, concat_axis: int,
-    pipelined: bool = False,
+    pipelined: bool = False, codec: Optional[str] = None,
 ):
     """The same split i->j move as p-1 ppermute hops: at distance d every
     device ships ONE neighbor block, so only 2·(local/p) bytes are in
     flight per step — the minimal-footprint schedule. ``pipelined``
     prefetch-issues hop d+1's ppermute before scattering hop d's
     received block (hops slice independently from ``x``, so the rotation
-    is a pure reorder: same hops, bit-identical output)."""
+    is a pure reorder: same hops, bit-identical output). ``codec``
+    encodes each hop's neighbor block before the ppermute and decodes
+    in the place slot — same hops, quarter the wire."""
+    from ..kernels import quant as _quant  # noqa: F401 (codec path only)
+
     r = lax.axis_index(axis_name)
     S = x.shape[split_axis]
     Bs = S // p
@@ -228,12 +339,24 @@ def _ring_exchange(
         d * p if k == concat_axis else (Bs if k == split_axis else d)
         for k, d in enumerate(x.shape)
     )
+    blk_shape = tuple(Bs if k == split_axis else d for k, d in enumerate(x.shape))
+    blk_elems = 1
+    for d in blk_shape:
+        blk_elems *= d
 
     def hop(d):
         blk = lax.dynamic_slice_in_dim(x, ((r + d) % p) * Bs, Bs, axis=split_axis)
+        if codec is not None:
+            blk = _quant.encode_blocks(blk.reshape(1, blk_elems), codec)
         return lax.ppermute(blk, axis_name, [(s, (s + d) % p) for s in range(p)])
 
     def place(out, recv, d):
+        if codec is not None:
+            recv = (
+                _quant.decode_blocks(recv, blk_elems, codec)
+                .astype(x.dtype)
+                .reshape(blk_shape)
+            )
         return lax.dynamic_update_slice_in_dim(
             out, recv, ((r - d) % p) * Bc, axis=concat_axis
         )
@@ -248,14 +371,19 @@ def _ring_exchange(
 # program builders (one compiled program per (comm, spec, budget))      #
 # --------------------------------------------------------------------- #
 @functools.lru_cache(maxsize=512)
-def _move_program(comm, spec: RedistSpec, budget: int, pipelined: bool = False):
+def _move_program(
+    comm, spec: RedistSpec, budget: int, pipelined: bool = False,
+    wire: Optional[str] = None,
+):
     """split i -> split j (all-to-all / chunked / ring) on the physical
     array: pad dst axis (local) -> shard_map exchange -> drop src-axis
     pad (local). ``pipelined`` selects the depth-2 prefetch-issue form
     of the chunk/hop loops (same collectives, bit-identical output) and
     is part of the program cache key — flipping the
-    ``HEAT_TPU_REDIST_OVERLAP`` gate rebuilds the program."""
-    sched = _planner.plan(spec, budget)
+    ``HEAT_TPU_REDIST_OVERLAP`` gate rebuilds the program. ``wire``
+    (the plan's codec mode, cache-keyed the same way) compiles the
+    encoded-payload loop forms when the plan carries codec steps."""
+    sched = _planner.plan(spec, budget, quant=wire or "0")
     mesh, axis_name = comm.mesh, comm.axis_name
     p = spec.mesh_size
     i, j = spec.src_split, spec.dst_split
@@ -264,14 +392,18 @@ def _move_program(comm, spec: RedistSpec, budget: int, pipelined: bool = False):
     Nip, Njp = _pad_extent(Ni, p), _pad_extent(Nj, p)
     C = max(_a2a_chunks(sched)[0], 1)
     ring = sched.strategy == "ring"
+    codec, qin, _ = _quant_flags(sched)
+    codec = codec if qin else None
 
     def body(xl):
         if ring:
             return _ring_exchange(
-                xl, axis_name, p, split_axis=j, concat_axis=i, pipelined=pipelined
+                xl, axis_name, p, split_axis=j, concat_axis=i,
+                pipelined=pipelined, codec=codec,
             )
         return _chunked_all_to_all(
-            xl, axis_name, p, split_axis=j, concat_axis=i, C=C, pipelined=pipelined
+            xl, axis_name, p, split_axis=j, concat_axis=i, C=C,
+            pipelined=pipelined, codec=codec,
         )
 
     mapped = shard_map(
@@ -298,12 +430,17 @@ def _move_program(comm, spec: RedistSpec, budget: int, pipelined: bool = False):
 
 
 @functools.lru_cache(maxsize=512)
-def _pivot_program(comm, spec: RedistSpec, budget: int, pipelined: bool = False):
+def _pivot_program(
+    comm, spec: RedistSpec, budget: int, pipelined: bool = False,
+    wire: Optional[str] = None,
+):
     """Reshape-with-repartition through the split-0 pivot: all-to-all to
     the flat-contiguous split-0 layout, LOCAL row-major reshape (the
     minor-dim packing copy runs at full width), all-to-all out. Both
-    chunk groups run ``pipelined`` as decorated prefetch-issue loops."""
-    sched = _planner.plan(spec, budget)
+    chunk groups run ``pipelined`` as decorated prefetch-issue loops;
+    each engages the wire codec independently per the plan's codec
+    steps (``wire`` keys the cache)."""
+    sched = _planner.plan(spec, budget, quant=wire or "0")
     mesh, axis_name = comm.mesh, comm.axis_name
     p = spec.mesh_size
     s, t = spec.src_split, spec.dst_split
@@ -311,12 +448,14 @@ def _pivot_program(comm, spec: RedistSpec, budget: int, pipelined: bool = False)
     ndim_in, ndim_out = len(in_shape), len(out_shape)
     n_in, n_out = _a2a_chunks(sched)
     C1, C2 = max(n_in, 1), max(n_out, 1)
+    codec, qin, qout = _quant_flags(sched)
 
     def body(xl):
         y = xl
         if s is not None and s != 0:
             y = _chunked_all_to_all(
-                y, axis_name, p, split_axis=0, concat_axis=s, C=C1, pipelined=pipelined
+                y, axis_name, p, split_axis=0, concat_axis=s, C=C1,
+                pipelined=pipelined, codec=codec if qin else None,
             )
             in_s, in_sp = in_shape[s], _pad_extent(in_shape[s], p)
             if in_sp != in_s:
@@ -330,7 +469,8 @@ def _pivot_program(comm, spec: RedistSpec, budget: int, pipelined: bool = False)
                 widths[t] = (0, out_tp - out_t)
                 y = jnp.pad(y, widths)
             y = _chunked_all_to_all(
-                y, axis_name, p, split_axis=t, concat_axis=0, C=C2, pipelined=pipelined
+                y, axis_name, p, split_axis=t, concat_axis=0, C=C2,
+                pipelined=pipelined, codec=codec if qout else None,
             )
         return y
 
@@ -381,7 +521,8 @@ def _relayout_impls(
 
 @functools.lru_cache(maxsize=512)
 def _packed_pivot_program(
-    comm, spec: RedistSpec, budget: int, impl_in, impl_out, pipelined: bool = False
+    comm, spec: RedistSpec, budget: int, impl_in, impl_out,
+    pipelined: bool = False, wire: Optional[str] = None,
 ):
     """The lane-packing pivot (``packed-pivot``): narrow-minor stages
     run on (p, rows·cols/p) column-grouped FLAT buffers so the chunked
@@ -392,7 +533,7 @@ def _packed_pivot_program(
     Same collective census as the direct pivot."""
     from ..kernels import relayout as _relayout
 
-    sched = _planner.plan(spec, budget)
+    sched = _planner.plan(spec, budget, quant=wire or "0")
     mesh, axis_name = comm.mesh, comm.axis_name
     p = spec.mesh_size
     s, t = spec.src_split, spec.dst_split
@@ -403,17 +544,22 @@ def _packed_pivot_program(
     n_in, n_out = _a2a_chunks(sched)
     C1, C2 = max(n_in, 1), max(n_out, 1)
     packed_in, packed_out = _packed_flags(sched)
+    codec, qin, qout = _quant_flags(sched)
+    codec_in = codec if qin else None
+    codec_out = codec if qout else None
 
     def body(xl):
         if s == 1:
             if packed_in:
                 grouped = xl.reshape(p, R0 * cs0)  # free row-block grouping
-                recv = _chunked_a2a_flat(grouped, axis_name, p, C1, pipelined=pipelined)
+                recv = _chunked_a2a_flat(
+                    grouped, axis_name, p, C1, pipelined=pipelined, codec=codec_in
+                )
                 flat = _relayout.unpack_rows(recv, R0, c0p, c0, p, impl=impl_in)
             else:
                 y = _chunked_all_to_all(
                     xl, axis_name, p, split_axis=0, concat_axis=1, C=C1,
-                    pipelined=pipelined,
+                    pipelined=pipelined, codec=codec_in,
                 )
                 if c0p != c0:
                     y = lax.slice_in_dim(y, 0, c0, axis=1)
@@ -423,7 +569,9 @@ def _packed_pivot_program(
         if t == 1:
             if packed_out:
                 grouped = _relayout.pack_rows(flat, R1, c1, c1p, p, impl=impl_out)
-                recv = _chunked_a2a_flat(grouped, axis_name, p, C2, pipelined=pipelined)
+                recv = _chunked_a2a_flat(
+                    grouped, axis_name, p, C2, pipelined=pipelined, codec=codec_out
+                )
                 # rows arrive in global order: the reshape IS the single
                 # lane-amplified materialization of the requested layout
                 return recv.reshape(r1, cs1)
@@ -431,7 +579,8 @@ def _packed_pivot_program(
             if c1p != c1:
                 y = jnp.pad(y, ((0, 0), (0, c1p - c1)))
             return _chunked_all_to_all(
-                y, axis_name, p, split_axis=1, concat_axis=0, C=C2, pipelined=pipelined
+                y, axis_name, p, split_axis=1, concat_axis=0, C=C2,
+                pipelined=pipelined, codec=codec_out,
             )
         return flat.reshape(R1, c1)
 
@@ -554,9 +703,14 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
         sched = _planner.plan(spec)
     else:
         # the program builders compile the PLANNER's schedule for
-        # (spec, budget) — a hand-built/modified Schedule would be
-        # silently ignored, so refuse it instead
-        planned = _planner.plan(spec, sched.budget_bytes)
+        # (spec, budget, codec) — a hand-built/modified Schedule would
+        # be silently ignored, so refuse it instead (a caller-provided
+        # sched pins ITS codec: passing a quantized plan executes the
+        # codec program regardless of the ambient gate)
+        planned = _planner.plan(
+            spec, sched.budget_bytes,
+            quant=sched.quant["mode"] if sched.quant else "0",
+        )
         if planned.plan_id != sched.plan_id:
             raise ValueError(
                 f"execute: schedule {sched.plan_id} is not the planner's "
@@ -568,6 +722,7 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
         _telemetry.inc("redist.execute.calls")
     strategy = sched.strategy
     budget = sched.budget_bytes
+    wire = sched.quant["mode"] if sched.quant else None
     # a program only HAS a pipelined issue order when the plan carries
     # tagged laps (chunk groups / ring hops): single-collective plans and
     # the barrier strategies (replicate/gather-reshape/local-reshape)
@@ -579,6 +734,14 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
         _telemetry.inc(
             "redist.overlap.pipelined" if pipelined else "redist.overlap.sequential"
         )
+        if sched.n_collectives:
+            # bytes-on-wire accounting (ISSUE 7): raw = full-width
+            # payload of the plan's collectives, sent = what actually
+            # crosses the mesh (the encoded bytes under the codec)
+            raw, sent = sched.wire_bytes_raw, sched.wire_bytes_sent
+            _telemetry.inc("redist.wire.bytes_raw", raw)
+            _telemetry.inc("redist.wire.bytes_sent", sent)
+            _telemetry.inc("redist.wire.saved", raw - sent)
     if strategy == "noop":
         return phys
     if strategy in ("slice",) or (strategy == "local" and not spec.is_reshape):
@@ -590,26 +753,26 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
         # its SL102 finding reports as info with the plan id attached
         return _gather_reshape_program(comm, spec, budget)(phys)
     if strategy in ("all-to-all", "chunked-all-to-all", "ring"):
-        return _move_program(comm, spec, budget, pipelined)(phys)
+        return _move_program(comm, spec, budget, pipelined, wire)(phys)
     if strategy == "split0-pivot":
         if _telemetry._ENABLED:
             _telemetry.inc("redist.relayout.direct")
-        return _pivot_program(comm, spec, budget, pipelined)(phys)
+        return _pivot_program(comm, spec, budget, pipelined, wire)(phys)
     if strategy == "packed-pivot":
         if _telemetry._ENABLED:
             _telemetry.inc("redist.relayout.packed")
         impl_in, impl_out = _relayout_impls(
             spec, sched, concrete=not isinstance(phys, jax.core.Tracer)
         )
-        return _packed_pivot_program(comm, spec, budget, impl_in, impl_out, pipelined)(
-            phys
-        )
+        return _packed_pivot_program(
+            comm, spec, budget, impl_in, impl_out, pipelined, wire
+        )(phys)
     if strategy == "gather-reshape":
         return _gather_reshape_program(comm, spec, budget)(phys)
     if strategy in ("local-reshape", "local"):
         if spec.src_split == 0 and spec.dst_split == 0 and spec.mesh_size > 1:
             # divisible split-0 <-> split-0: device blocks stay put
-            return _pivot_program(comm, spec, budget, pipelined)(phys)
+            return _pivot_program(comm, spec, budget, pipelined, wire)(phys)
         return _local_reshape_program(comm, spec, budget)(phys)
     raise ValueError(f"unknown strategy {strategy!r} (plan {sched.plan_id})")
 

@@ -74,9 +74,22 @@ only switches the executor between the sequential oracle and the
 prefetch-issue-then-consume program form. The annotation folds into the
 canonical serialization and ``plan_id``.
 
-Plans are cached per ``(spec, budget)`` and feed the PR-1 telemetry
-registry: ``redist.plan_cache.{hit,miss}``, ``redist.planned_bytes``,
-``redist.steps``, ``redist.peak_bytes``.
+Wire quantization (ISSUE 7): after selection, the winning plan's
+admissible collective groups are wrapped in ``quantize``/``dequantize``
+codec steps (``heat_tpu.kernels.quant`` — int8 payloads with one f32
+scale per 1024-element tile, ~0.251×, or the bf16 cast at 0.5×) under
+the ``HEAT_TPU_WIRE_QUANT`` gate. Running the codec pass AFTER
+``_select`` is what makes the census gate-invariant by construction:
+the gate can change how many bytes each collective carries, never which
+strategy wins or how many collectives launch. Admissibility is the
+numerics-tolerance policy: float32 transient exchanges of at least
+``QUANT_MIN_WIRE_BYTES`` full-width — everything else (ints, f64,
+small moves, the materializing replicate/gather strategies) ships
+exact-bit under every gate value.
+
+Plans are cached per ``(spec, budget, codec)`` and feed the PR-1
+telemetry registry: ``redist.plan_cache.{hit,miss}``,
+``redist.planned_bytes``, ``redist.steps``, ``redist.peak_bytes``.
 """
 
 from __future__ import annotations
@@ -97,6 +110,8 @@ __all__ = [
     "ALPHA_BYTES",
     "DEFAULT_BUDGET_MB",
     "OVERLAP_ENV",
+    "QUANT_MIN_WIRE_BYTES",
+    "WIRE_QUANT_ENV",
     "budget_bytes",
     "clear_plan_cache",
     "explain",
@@ -104,6 +119,8 @@ __all__ = [
     "overlap_mode",
     "plan",
     "planner_enabled",
+    "wire_quant_gate",
+    "wire_quant_mode",
 ]
 
 #: per-collective launch latency expressed in byte-equivalents (~1 MiB
@@ -125,8 +142,26 @@ OVERLAP_ENV = "HEAT_TPU_REDIST_OVERLAP"
 OVERLAP_GRAIN_BYTES = 32 << 20
 _OVERLAP_MAX_LAPS = 4
 
+WIRE_QUANT_ENV = "HEAT_TPU_WIRE_QUANT"
+
+#: a collective GROUP (one chunk pipeline / ring / standalone exchange)
+#: engages the wire codec only when its full-width payload reaches this
+#: size — smaller exchanges are latency-bound (ALPHA, not bytes), and
+#: keeping them exact-bit is what lets every small-array contract in
+#: the suite (executor equivalence, pinned censuses, escape-hatch
+#: parity) hold verbatim even under the forced HEAT_TPU_WIRE_QUANT=1
+#: CI leg.
+QUANT_MIN_WIRE_BYTES = 2 << 20
+
+#: strategies whose collectives ship TRANSIENT exchange payloads — the
+#: codec's domain. ``replicate``/``gather-reshape`` materialize the
+#: array values compute then consumes, so they stay exact-bit always.
+_QUANT_STRATEGIES = (
+    "all-to-all", "chunked-all-to-all", "ring", "split0-pivot", "packed-pivot",
+)
+
 _plan_lock = threading.Lock()
-_plan_cache: Dict[Tuple[RedistSpec, int], Schedule] = {}
+_plan_cache: Dict[Tuple[RedistSpec, int, str], Schedule] = {}
 #: bounded like the executor's program caches (lru_cache(512)); planning
 #: is cheap pure Python, so FIFO eviction on overflow is plenty
 _PLAN_CACHE_MAX = 4096
@@ -155,6 +190,43 @@ def overlap_mode() -> str:
     if v in ("1", "on", "true", "force", "yes"):
         return "1"
     return "auto"
+
+
+def wire_quant_mode() -> str:
+    """Parsed ``HEAT_TPU_WIRE_QUANT`` (``"0"``/``"1"``/``"bf16"``/
+    ``"auto"``). ``0`` is the escape hatch (every wire stays full-width
+    exact-bit — the PR 6 program forms verbatim); ``1`` forces the int8
+    codec on every admissible exchange on any backend (the CI leg);
+    ``bf16`` forces the cast codec the same way; the default ``auto``
+    engages the lossy int8 codec only on the TPU backend — where the
+    ICI wire is the modeled binding term and the pinned tolerance is
+    the documented trade — and keeps every other backend exact-bit, so
+    the CPU tier-1 contracts hold untouched by default."""
+    v = os.environ.get(WIRE_QUANT_ENV, "auto").strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return "0"
+    if v in ("1", "on", "true", "force", "yes", "int8"):
+        return "1"
+    if v == "bf16":
+        return "bf16"
+    return "auto"
+
+
+def wire_quant_gate() -> Optional[str]:
+    """The codec mode the current gate resolves to (``"int8"``/
+    ``"bf16"``) or ``None`` when every wire stays full-width. Per-spec
+    admissibility (dtype/strategy/size — the numerics-tolerance policy)
+    is decided separately at planning time."""
+    m = wire_quant_mode()
+    if m == "0":
+        return None
+    if m == "1":
+        return "int8"
+    if m == "bf16":
+        return "bf16"
+    import jax
+
+    return "int8" if jax.default_backend() == "tpu" else None
 
 
 def budget_bytes() -> int:
@@ -792,6 +864,155 @@ def _select(candidates: List[Schedule]) -> Schedule:
 
 
 # --------------------------------------------------------------------- #
+# wire quantization (ISSUE 7): the codec pass over a selected plan      #
+# --------------------------------------------------------------------- #
+def _quantize_schedule(sched: Schedule, mode: Optional[str]) -> Schedule:
+    """Wrap the admissible collective groups of a SELECTED plan in
+    ``quantize``/``dequantize`` codec steps (``heat_tpu.kernels.quant``)
+    and scale their ``bytes_moved`` to the encoded wire size.
+
+    Runs AFTER strategy selection, on the winner only: the gate can
+    therefore never flip which strategy (or how many collectives) a
+    spec plans to — censuses and lap structure are identical gate-on vs
+    gate-off by construction, which is the invariant every golden pin
+    relies on. The numerics-tolerance policy lives here: float32
+    payloads only (ints/bools/f64 are never lossy on the wire — they
+    ship exact-bit), transient-exchange strategies only (replicate/
+    gather-reshape materialize consumed values), and only groups
+    shipping at least ``QUANT_MIN_WIRE_BYTES`` full-width (smaller
+    exchanges are latency-bound and stay exact). The overlap groups'
+    critical-path models are rebuilt on the encoded wire bytes — the
+    codec shrinks the ``wire`` leg of ``max(wire, copy)``, which is
+    exactly the ICI-bound rows' binding term."""
+    if mode is None:
+        return sched
+    spec = sched.spec
+    if spec.dtype != "float32" or sched.strategy not in _QUANT_STRATEGIES:
+        return sched
+    from ..kernels import quant as _quant
+
+    p = spec.mesh_size
+    item = spec.itemsize
+    groups: Dict[str, List[int]] = {}
+    for idx, st in enumerate(sched.steps):
+        if st.is_collective:
+            key = st.overlap if st.overlap is not None else f"_solo{idx}"
+            groups.setdefault(key, []).append(idx)
+    sent_of: Dict[int, int] = {}
+    for key, idxs in groups.items():
+        if sum(sched.steps[i].bytes_moved for i in idxs) < QUANT_MIN_WIRE_BYTES:
+            continue
+        for i in idxs:
+            st = sched.steps[i]
+            if st.kind == "ppermute":
+                # one neighbor block per hop
+                sent_of[i] = _quant.wire_bytes(st.bytes_moved // item, mode)
+            else:
+                # crossing payload = (p-1) per-destination blocks, each
+                # encoded independently (the executor's wire rows)
+                blk_elems = st.bytes_moved // (p - 1) // item
+                sent_of[i] = (p - 1) * _quant.wire_bytes(blk_elems, mode)
+    if not sent_of:
+        return sched
+
+    raw_total = sched.bytes_moved
+    new_steps: List[Step] = []
+    for i, st in enumerate(sched.steps):
+        if i not in sent_of:
+            new_steps.append(st)
+            continue
+        sent = sent_of[i]
+        raw = st.bytes_moved
+        if st.kind == "ppermute":
+            full_local = raw
+            enc_write = sent
+        else:
+            full_local = raw * p // (p - 1)  # incl. the resident diagonal block
+            enc_write = sent * p // (p - 1)
+        new_steps.append(
+            Step(
+                "quantize",
+                bytes_copied=enc_write,
+                peak_bytes=enc_write,
+                detail=(
+                    f"{mode}-encode wire blocks ({_quant.TILE}-elem tile "
+                    f"scales): {raw} B -> {sent} B on the wire "
+                    f"(saved {raw - sent} B)"
+                ),
+                chunk=st.chunk,
+                overlap=st.overlap,
+            )
+        )
+        new_steps.append(
+            Step(
+                st.kind,
+                bytes_moved=sent,
+                peak_bytes=st.peak_bytes,
+                detail=st.detail + f" [{mode} wire]",
+                chunk=st.chunk,
+                lane_fill=1.0,  # encoded payloads are dense flat byte streams
+                overlap=st.overlap,
+            )
+        )
+        new_steps.append(
+            Step(
+                "dequantize",
+                bytes_copied=0 if st.overlap else full_local,
+                peak_bytes=0 if st.overlap else full_local,
+                detail=(
+                    f"{mode}-decode received blocks"
+                    + (
+                        " (full-width write rides the group's reassembly copy)"
+                        if st.overlap
+                        else f" ({full_local} B full-width write)"
+                    )
+                ),
+                chunk=st.chunk,
+                overlap=st.overlap,
+            )
+        )
+
+    new_overlap = sched.overlap
+    if sched.overlap:
+        rebuilt = []
+        for g in sched.overlap["groups"]:
+            idxs = [i for i in groups.get(g["tag"], []) if i in sent_of]
+            if not idxs:
+                rebuilt.append(g)
+                continue
+            wire_new = sum(sent_of[i] for i in idxs)
+            rebuilt.append(
+                _overlap_group(g["tag"], g["laps"], wire_new, g["copy_bytes"])
+            )
+        new_overlap = _overlap_annotation(rebuilt)
+
+    sent_total = raw_total - sum(
+        sched.steps[i].bytes_moved for i in sent_of
+    ) + sum(sent_of.values())
+    ann = {
+        "mode": mode,
+        "tol": _quant.tolerance(mode),
+        "bytes_raw": int(raw_total),
+        "bytes_sent": int(sent_total),
+        "ratio": round(sent_total / raw_total, 4) if raw_total else 1.0,
+        "min_group_bytes": QUANT_MIN_WIRE_BYTES,
+    }
+    notes = sched.notes + ("; " if sched.notes else "") + (
+        f"{mode} wire codec on {len(sent_of)} collective step(s) "
+        f"(kernels.quant, tol {ann['tol']})"
+    )
+    return Schedule(
+        spec,
+        sched.strategy,
+        new_steps,
+        sched.budget_bytes,
+        notes=notes,
+        overlap=new_overlap,
+        quant=ann,
+    )
+
+
+# --------------------------------------------------------------------- #
 # the planner                                                           #
 # --------------------------------------------------------------------- #
 def _build(spec: RedistSpec, budget: int) -> Schedule:
@@ -853,19 +1074,38 @@ def _build(spec: RedistSpec, budget: int) -> Schedule:
     return _select(_resplit_candidates(spec, budget))
 
 
-def plan(spec: RedistSpec, budget: Optional[int] = None) -> Schedule:
+def plan(
+    spec: RedistSpec, budget: Optional[int] = None, quant: Optional[str] = None
+) -> Schedule:
     """Plan ``spec`` under ``budget`` bytes (default: the env knob).
-    Cached per (spec, budget); cache hits/misses and the planned
+
+    ``quant`` pins the wire codec explicitly — ``"0"`` plans the
+    full-width exact-bit schedule, ``"int8"``/``"bf16"`` force that
+    codec through the admissibility policy, and the default ``None``
+    resolves the ``HEAT_TPU_WIRE_QUANT`` gate (:func:`wire_quant_gate`).
+    Plans are cached per (spec, budget, resolved codec) — the codec is
+    part of the canonical serialization and plan_id, so a gate flip can
+    never serve a stale plan. Cache hits/misses and the planned
     byte/step/peak totals feed the telemetry registry."""
     b = budget_bytes() if budget is None else int(budget)
-    key = (spec, b)
+    if quant is None:
+        qmode = wire_quant_gate()
+    elif quant in ("0", "off", None):
+        qmode = None
+    else:
+        from ..kernels.quant import MODES as _MODES
+
+        if quant not in _MODES:
+            raise ValueError(f"plan: unknown wire codec {quant!r}")
+        qmode = quant
+    key = (spec, b, qmode or "0")
     with _plan_lock:
         cached = _plan_cache.get(key)
     if cached is not None:
         if _telemetry._ENABLED:
             _telemetry.inc("redist.plan_cache.hit")
         return cached
-    sched = _build(spec, b)
+    sched = _quantize_schedule(_build(spec, b), qmode)
     with _plan_lock:
         if len(_plan_cache) >= _PLAN_CACHE_MAX:
             _plan_cache.pop(next(iter(_plan_cache)))
@@ -888,6 +1128,8 @@ def plan(spec: RedistSpec, budget: Optional[int] = None) -> Schedule:
             critical_path_model=(
                 sched.overlap["model_speedup"] if sched.overlap else None
             ),
+            quant=sched.quant["mode"] if sched.quant else None,
+            wire_bytes_saved=sched.wire_bytes_raw - sched.wire_bytes_sent,
         )
     return sched
 

@@ -32,6 +32,18 @@ executor consults it (plus the ``HEAT_TPU_REDIST_OVERLAP`` gate) to
 decide whether to emit the prefetch-issue-then-consume program form.
 Pipelining never changes WHAT moves — census and numerics are
 bit-identical overlap-on vs overlap-off by construction.
+
+ISSUE 7 adds the **wire-codec steps**: under ``HEAT_TPU_WIRE_QUANT``
+the planner wraps admissible collective groups in ``quantize``/
+``dequantize`` steps (``heat_tpu.kernels.quant`` — int8/bf16 payloads,
+scale per (8,128) tile), scales the collectives' ``bytes_moved`` to
+the encoded wire bytes, and attaches a schedule-level ``quant``
+annotation ({mode, tol, bytes_raw, bytes_sent, ratio}). The codec
+changes HOW MANY BYTES each collective carries, never how many
+collectives launch: the census (and the lap/pipe structure) is
+identical gate-on vs gate-off by construction, while the canonical
+serialization — and therefore the ``plan_id`` and every program cache
+key derived from it — distinguishes the quantized plan.
 """
 
 from __future__ import annotations
@@ -57,7 +69,15 @@ COLLECTIVE_STEP_KINDS: Dict[str, str] = {
 # (heat_tpu.kernels.relayout): pack folds narrow rows into the lane
 # axis so the collective steps run on full-VREG buffers; unpack
 # materializes the destination's narrow layout in ONE copy.
-_LOCAL_STEP_KINDS = ("slice", "pad", "reshape", "concat", "pack", "unpack")
+# ``quantize``/``dequantize`` are the wire-codec copies
+# (heat_tpu.kernels.quant): quantize encodes the collective's
+# per-destination blocks to the int8/bf16 wire format, dequantize
+# restores full width on the receive side (riding the group's
+# reassembly copy in the pipelined forms).
+_LOCAL_STEP_KINDS = (
+    "slice", "pad", "reshape", "concat", "pack", "unpack",
+    "quantize", "dequantize",
+)
 
 
 class Step:
@@ -66,7 +86,8 @@ class Step:
     Attributes
     ----------
     kind : ``all_to_all`` | ``all_gather`` | ``ppermute`` | ``slice`` |
-        ``pad`` | ``reshape`` | ``concat`` | ``pack`` | ``unpack``.
+        ``pad`` | ``reshape`` | ``concat`` | ``pack`` | ``unpack`` |
+        ``quantize`` | ``dequantize``.
     bytes_moved : per-device payload crossing the mesh (collectives;
         0 for local steps).
     bytes_copied : per-device HBM bytes a LOCAL relayout copy writes
@@ -170,6 +191,7 @@ class Schedule:
         budget_bytes: int,
         notes: str = "",
         overlap: Optional[Dict[str, Any]] = None,
+        quant: Optional[Dict[str, Any]] = None,
     ):
         self.spec = spec
         self.strategy = strategy
@@ -177,6 +199,7 @@ class Schedule:
         self.budget_bytes = int(budget_bytes)
         self.notes = notes
         self.overlap = overlap
+        self.quant = quant
         self.plan_id = hashlib.sha1(
             self.canonical_json(with_plan_id=False).encode()
         ).hexdigest()[:12]
@@ -216,6 +239,20 @@ class Schedule:
     @property
     def within_budget(self) -> bool:
         return self.peak_bytes <= self.budget_bytes
+
+    @property
+    def wire_bytes_sent(self) -> int:
+        """Per-device bytes that actually cross the mesh: the current
+        steps' payload sum — the encoded wire bytes when the plan
+        carries a ``quant`` annotation, else :attr:`bytes_moved`."""
+        return self.bytes_moved
+
+    @property
+    def wire_bytes_raw(self) -> int:
+        """Per-device full-width payload the same movement would ship
+        without the wire codec (== :attr:`wire_bytes_sent` for
+        unquantized plans)."""
+        return int(self.quant["bytes_raw"]) if self.quant else self.bytes_moved
 
     @property
     def overlap_depth(self) -> int:
@@ -277,6 +314,7 @@ class Schedule:
             "within_budget": self.within_budget,
             "notes": self.notes,
             "overlap": self.overlap,
+            "quant": self.quant,
         }
         if with_plan_id:
             d["plan_id"] = self.plan_id
@@ -329,6 +367,16 @@ class Schedule:
             )
         else:
             lines.append("  overlap: none (sequential schedule)")
+        if self.quant:
+            q = self.quant
+            lines.append(
+                f"  quant: {q['mode']} wire codec  "
+                f"raw={q['bytes_raw']} B -> sent={q['bytes_sent']} B "
+                f"(saved {q['bytes_raw'] - q['bytes_sent']} B, "
+                f"ratio {q['ratio']}, tol {q['tol']})"
+            )
+        else:
+            lines.append("  quant: none (full-width wire)")
         if self.notes:
             lines.append(f"  notes: {self.notes}")
         return "\n".join(lines)
@@ -338,7 +386,8 @@ class Schedule:
             s.kind + (f"[{s.chunk}]" if s.chunk is not None else "") for s in self.steps
         ]
         ov = f", overlap=depth{self.overlap_depth}" if self.overlap else ""
+        qt = f", quant={self.quant['mode']}" if self.quant else ""
         return (
             f"Schedule({self.strategy}, plan={self.plan_id}, {self.spec!r}, "
-            f"steps={kinds}, peak={self.peak_bytes}B/{self.budget_bytes}B{ov})"
+            f"steps={kinds}, peak={self.peak_bytes}B/{self.budget_bytes}B{ov}{qt})"
         )

@@ -70,6 +70,10 @@ HIGHER_IS_BETTER = {
     # ride in the compact key_rows so driver artifacts gate them
     "critical_path_model",
     "vs_sequential",
+    # wire-quantization acceptance field (ISSUE 7): the analytic
+    # v5e-64 quantized-gradient DP model's step-time speedup
+    # (dp_step_quant row; tests pin >= 1.5x on ICI-bound layers)
+    "dp_model_speedup",
 }
 
 # rows that changed name across rounds: a baseline row under the old
@@ -87,6 +91,10 @@ LOWER_IS_BETTER = {
     # the kernel-ring wrapper cost relative to bare splash: growth is a
     # real regression (bench.py flags <0.9 samples as weather)
     "vs_splash_row",
+    # ISSUE 7: encoded/raw wire bytes of the executing plan on the
+    # gated redistribution rows (and the dp_step_quant model row) —
+    # a ratio drifting back toward 1.0 means the codec disengaged
+    "wire_ratio",
 }
 
 

@@ -71,6 +71,21 @@ HEAT_TPU_REDIST_OVERLAP=1 python -m pytest tests/test_overlap.py tests/test_redi
 
 HEAT_TPU_REDIST_OVERLAP=0 python -m pytest tests/test_overlap.py tests/test_redistribution.py -q "$@"
 
+# wire-quant legs (ISSUE 7), mirroring the overlap legs: the int8 wire
+# codec FORCED on CPU over the redistribution + optim suites — the
+# admissibility policy keeps every bit-exact contract exact while the
+# big-spec programs compile (and the mid-size ones execute) with
+# encoded payloads (leg 14); and the HEAT_TPU_WIRE_QUANT=0 escape
+# hatch, proving the full-width plans/programs are byte-identical to
+# the PR 6 forms (leg 15). (The codec is pure XLA — no Pallas path to
+# interpret-gate. RingKernelAttention is excluded the way the PR-2
+# notes document: those tests carry a container capability gate —
+# head_dim multiples of 128 — that fails STANDALONE on this image with
+# or without any quant gate; leg 1 covers them in the full suite.)
+HEAT_TPU_WIRE_QUANT=1 python -m pytest tests/test_quant.py tests/test_redistribution.py tests/test_nn_optim.py -q -k "not RingKernelAttention" "$@"
+
+HEAT_TPU_WIRE_QUANT=0 python -m pytest tests/test_quant.py tests/test_redistribution.py tests/test_overlap.py -q "$@"
+
 python scripts/lint.py heat_tpu/
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \

@@ -14,6 +14,12 @@ annotation is gate-independent (``HEAT_TPU_REDIST_OVERLAP`` switches
 the executor's issue order, never the plan), so an ambient gate cannot
 make two runs diverge either.
 
+ISSUE 7: every golden spec is dumped TWICE — the full-width plan
+(``quant="0"``) and the forced-int8 plan (``quant="int8"``, suffixed
+``.quant``) — both pinned explicitly, so the quant-annotated plan_ids
+are covered by the determinism diff and an ambient ``HEAT_TPU_WIRE_QUANT``
+cannot make two CI runs diverge.
+
 Pure Python: no mesh, no jax device work — safe on any container.
 """
 
@@ -27,12 +33,16 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 def main() -> int:
     from heat_tpu.redistribution import planner
 
-    # the default budget, pinned explicitly so an ambient
-    # HEAT_TPU_REDIST_BUDGET_MB cannot make two CI runs diverge
+    # the default budget and codec, pinned explicitly so an ambient
+    # HEAT_TPU_REDIST_BUDGET_MB / HEAT_TPU_WIRE_QUANT cannot make two
+    # CI runs diverge
     budget = planner.DEFAULT_BUDGET_MB << 20
     for name, spec in planner.golden_specs():
-        sched = planner.plan(spec, budget)
+        sched = planner.plan(spec, budget, quant="0")
         print(f"{name}\t{sched.canonical_json()}")
+    for name, spec in planner.golden_specs():
+        sched = planner.plan(spec, budget, quant="int8")
+        print(f"{name}.quant\t{sched.canonical_json()}")
     return 0
 
 

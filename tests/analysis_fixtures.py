@@ -58,6 +58,34 @@ def donated_program(x):
     return ht.exp(x)
 
 
+def int8_wire_program(x):
+    """SL104 (narrowing arm): a hand-rolled UNSCALED ``astype(int8)``
+    feeding a psum — the gradient-compression accident: values outside
+    [-128, 127] truncate and the int8 reduction wraps. The sanctioned
+    narrowing is the STAMPED block-quantized wire codec
+    (``heat_tpu.kernels.quant``: per-tile scales, reserved special
+    codes, ``wire_codec_<mode>`` named scope) — only codec-stamped
+    converts downgrade to info; this one trips at error severity."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from heat_tpu.core._jax_compat import shard_map
+
+    comm = x.comm
+    phys = x._phys
+
+    def body(xl):
+        # no scale, no specials, straight into the collective
+        return lax.psum(xl.astype(jnp.int8), comm.axis_name).astype(jnp.float32)
+
+    spec = P(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+    out = P(*(None,) * phys.ndim)
+    return shard_map(
+        body, mesh=comm.mesh, in_specs=(spec,), out_specs=out, check_vma=False
+    )(phys)
+
+
 def ppermute_ring_program(x):
     """SL101: a hand-rolled ppermute relayout loop with NO plan stamp —
     every hop ships the whole local shard around the ring (an all-gather

@@ -77,6 +77,79 @@ class TestIRCheckBadFixture(TestCase):
         self.assertTrue(rep.ok)  # warning severity: reports, does not gate
 
     @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_unscaled_int8_narrowing_trips_sl104_at_error(self):
+        """ISSUE 7 golden bad-fixture: a hand-rolled UNSCALED
+        astype(int8) feeding a psum is the gradient-compression
+        accident the narrowing arm exists for — error severity, gates.
+        Only wire_codec-stamped converts (heat_tpu.kernels.quant)
+        downgrade to info; that pin lives in tests/test_quant.py."""
+        rep = ht.analysis.check(fx.int8_wire_program, ht.random.randn(64, 48, split=0))
+        sl104 = [f for f in rep.findings if f.rule == "SL104"]
+        self.assertTrue(sl104)
+        self.assertTrue(any(f.severity == "error" for f in sl104))
+        self.assertIn("kernels.quant", sl104[0].message)
+        self.assertFalse(rep.ok)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_int8_narrowing_inside_nested_jit_still_trips(self):
+        """The backward walk crosses call boundaries: an unscaled
+        astype(int8) hiding inside a nested jit wrapper whose OUTPUT
+        feeds the collective must trip the same error — the producer
+        map steps from the pjit eqn onto its sub-jaxpr's outvars."""
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as PS
+
+        from heat_tpu.core._jax_compat import shard_map
+
+        enc = jax.jit(lambda g: g.astype(jnp.int8))  # shardlint: ignore[SL202] -- fixture
+
+        x = ht.random.randn(64, 48, split=0)
+        comm = x.comm
+
+        def nested(v):
+            phys = v._phys
+
+            def body(xl):
+                return lax.psum(enc(xl), comm.axis_name).astype(jnp.float32)
+
+            spec = PS(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+            return shard_map(
+                body, mesh=comm.mesh, in_specs=(spec,),
+                out_specs=PS(*(None,) * phys.ndim), check_vma=False,
+            )(phys)
+
+        rep = ht.analysis.check(nested, x, scan_source=False)
+        sl104 = [f for f in rep.findings if f.rule == "SL104"]
+        self.assertTrue(any(f.severity == "error" for f in sl104))
+
+        # the inverse guard: a SIBLING int8 output of the same jit
+        # wrapper, NOT on the collective's dataflow path, must not trip
+        # (call outvars map 1:1 onto sub-jaxpr outvars — only the
+        # index-matched one continues the walk)
+        two = jax.jit(  # shardlint: ignore[SL202] -- fixture
+            lambda g: (g.astype(jnp.int8), g * 2.0)
+        )
+
+        def sibling(v):
+            phys = v._phys
+
+            def body(xl):
+                q, f = two(xl)
+                return lax.psum(f, comm.axis_name) + q.astype(jnp.float32).sum()
+
+            spec = PS(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+            return shard_map(
+                body, mesh=comm.mesh, in_specs=(spec,),
+                out_specs=PS(*(None,) * phys.ndim), check_vma=False,
+            )(phys)
+
+        clean = ht.analysis.check(sibling, x, scan_source=False)
+        self.assertFalse(
+            any(f.rule == "SL104" and f.severity == "error" for f in clean.findings)
+        )
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
     def test_donation_bookkeeping_suppresses_sl105(self):
         x = _big_split0()
         undonated = ht.analysis.check(ht.jit(fx.donated_program), x)

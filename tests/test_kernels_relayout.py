@@ -14,9 +14,6 @@ Three contracts pinned here:
    == direct), with the compiled HLO census equal to the plan's.
 """
 
-import contextlib
-import os
-
 import numpy as np
 import pytest
 
@@ -28,26 +25,14 @@ import heat_tpu as ht
 from heat_tpu.kernels import relayout
 from heat_tpu.redistribution import RedistSpec, executor, planner
 
-from test_suites.basic_test import TestCase
+from test_suites.basic_test import TestCase, env_pin
 
 P = len(jax.devices())
 BUDGET = planner.DEFAULT_BUDGET_MB << 20
 
 
-@contextlib.contextmanager
-def _env(name, value):
-    old = os.environ.get(name)
-    if value is None:
-        os.environ.pop(name, None)
-    else:
-        os.environ[name] = value
-    try:
-        yield
-    finally:
-        if old is None:
-            os.environ.pop(name, None)
-        else:
-            os.environ[name] = old
+# the shared env save/set/restore helper (test_suites.basic_test)
+_env = env_pin
 
 
 def _pack_oracle(x, rows, c_in, c_out, p):

@@ -27,8 +27,6 @@ The contract pinned here, three ways:
 ``=1`` forces pipelining — both legs run in ci.sh.
 """
 
-import os
-
 import numpy as np
 import pytest
 
@@ -44,30 +42,15 @@ from heat_tpu.observability.hlo import _count_ops
 from heat_tpu.redistribution import RedistSpec, executor, planner
 from heat_tpu.redistribution.schedule import Schedule, Step
 
-from test_suites.basic_test import TestCase
+from test_suites.basic_test import TestCase, env_pin
 
 P = len(jax.devices())
 BUDGET = planner.DEFAULT_BUDGET_MB << 20
 
 
-class _OverlapEnv:
-    """Context manager pinning HEAT_TPU_REDIST_OVERLAP for a block."""
-
-    def __init__(self, mode):
-        self.mode = mode
-
-    def __enter__(self):
-        self.old = os.environ.get(planner.OVERLAP_ENV)
-        if self.mode is None:
-            os.environ.pop(planner.OVERLAP_ENV, None)
-        else:
-            os.environ[planner.OVERLAP_ENV] = self.mode
-
-    def __exit__(self, *exc):
-        if self.old is None:
-            os.environ.pop(planner.OVERLAP_ENV, None)
-        else:
-            os.environ[planner.OVERLAP_ENV] = self.old
+def _OverlapEnv(mode):
+    """Pin HEAT_TPU_REDIST_OVERLAP for a block (shared env_pin helper)."""
+    return env_pin(planner.OVERLAP_ENV, mode)
 
 
 class TestOverlapAnnotation(TestCase):
@@ -531,26 +514,21 @@ class TestShardlintOverlap(TestCase):
         # device, ring peak 2L/p fits where chunking would need >= p laps,
         # and each ppermute hop ships L/p >= the check's min_bytes
         x = ht.zeros((2048 * P, 512), split=0)
-        old = os.environ.get("HEAT_TPU_REDIST_BUDGET_MB")
-        os.environ["HEAT_TPU_REDIST_BUDGET_MB"] = "1"
         try:
-            sched = ht.redistribution.explain(x, 1)
-            self.assertEqual(sched.strategy, "ring")
-            with _OverlapEnv("1"):
-                rep = ht.analysis.check(
-                    lambda v: v.resplit(1), x, min_bytes=1 << 17
-                )
-            hops = [f for f in rep.findings if f.op == "collective-permute"]
-            self.assertTrue(hops)
-            for f in hops:
-                self.assertEqual(f.severity, "info")
-                self.assertIn(sched.plan_id, f.message)
-            self.assertTrue(rep.ok)
+            with env_pin("HEAT_TPU_REDIST_BUDGET_MB", "1"):
+                sched = ht.redistribution.explain(x, 1)
+                self.assertEqual(sched.strategy, "ring")
+                with _OverlapEnv("1"):
+                    rep = ht.analysis.check(
+                        lambda v: v.resplit(1), x, min_bytes=1 << 17
+                    )
+                hops = [f for f in rep.findings if f.op == "collective-permute"]
+                self.assertTrue(hops)
+                for f in hops:
+                    self.assertEqual(f.severity, "info")
+                    self.assertIn(sched.plan_id, f.message)
+                self.assertTrue(rep.ok)
         finally:
-            if old is None:
-                os.environ.pop("HEAT_TPU_REDIST_BUDGET_MB", None)
-            else:
-                os.environ["HEAT_TPU_REDIST_BUDGET_MB"] = old
             planner.clear_plan_cache()
 
     def test_cmatmul_ring_reports_as_info(self):

@@ -14,8 +14,6 @@ matrix (``planner.golden_specs``) is pinned three ways:
    that lowers to a planner program (the acceptance criterion).
 """
 
-import os
-
 import numpy as np
 import pytest
 
@@ -29,7 +27,7 @@ from heat_tpu.observability.hlo import _count_ops
 from heat_tpu.redistribution import RedistSpec, executor, planner
 from heat_tpu.redistribution.schedule import Schedule, Step
 
-from test_suites.basic_test import TestCase
+from test_suites.basic_test import TestCase, env_pin
 
 P = len(jax.devices())
 
@@ -37,7 +35,13 @@ P = len(jax.devices())
 # HEAT_TPU_REDIST_BUDGET_MB cannot skew the golden pins
 BUDGET = planner.DEFAULT_BUDGET_MB << 20
 
-# name -> (strategy, n_steps, collective census) under the default budget
+# name -> (strategy, n_steps, collective census) under the default budget.
+# n_steps pins the CODEC-FREE step structure: under a forced
+# HEAT_TPU_WIRE_QUANT gate the admissible plans additionally carry
+# quantize/dequantize step pairs (ISSUE 7), which never change the
+# strategy, the collective census, or the lap structure — the pin test
+# subtracts them and separately asserts they are absent with the gate
+# off.
 GOLDEN_PINS = {
     "noop_same_split": ("noop", 0, {}),
     "resplit_0_to_1_p8": ("all-to-all", 1, {"all-to-all": 1}),
@@ -79,19 +83,23 @@ def _planner_program(comm, spec, budget, pipelined=False):
     """The jitted program the executor would run for ``spec``, or None
     for the direct-placement strategies (noop/local/slice/replicate).
     ``pipelined`` selects the ISSUE-6 software-pipelined issue order of
-    the chunk loops (same collectives; tests pin both forms)."""
-    strategy = planner.plan(spec, budget).strategy
+    the chunk loops (same collectives; tests pin both forms). The wire
+    codec follows the ambient HEAT_TPU_WIRE_QUANT gate through the
+    plan, exactly like execute() — so the forced CI leg compiles the
+    encoded-payload program forms here too."""
+    sched = planner.plan(spec, budget)
+    strategy = sched.strategy
+    wire = sched.quant["mode"] if sched.quant else None
     if strategy in ("noop", "local", "slice", "replicate"):
         return None
     if strategy in ("all-to-all", "chunked-all-to-all", "ring"):
-        return executor._move_program(comm, spec, budget, pipelined)
+        return executor._move_program(comm, spec, budget, pipelined, wire)
     if strategy == "split0-pivot":
-        return executor._pivot_program(comm, spec, budget, pipelined)
+        return executor._pivot_program(comm, spec, budget, pipelined, wire)
     if strategy == "packed-pivot":
-        sched = planner.plan(spec, budget)
         impl_in, impl_out = executor._relayout_impls(spec, sched)
         return executor._packed_pivot_program(
-            comm, spec, budget, impl_in, impl_out, pipelined
+            comm, spec, budget, impl_in, impl_out, pipelined, wire
         )
     if strategy == "gather-reshape":
         return executor._gather_reshape_program(comm, spec, budget)
@@ -107,8 +115,17 @@ class TestGoldenPlans(TestCase):
             strategy, n_steps, census = GOLDEN_PINS[name]
             sched = planner.plan(spec, BUDGET)
             self.assertEqual(sched.strategy, strategy, name)
-            self.assertEqual(sched.n_steps, n_steps, name)
+            # codec steps (forced HEAT_TPU_WIRE_QUANT legs) ride in
+            # pairs around collectives without changing the pinned
+            # structure; with the gate off there are none
+            quant_steps = sum(
+                1 for s in sched.steps if s.kind in ("quantize", "dequantize")
+            )
+            self.assertEqual(sched.n_steps - quant_steps, n_steps, name)
             self.assertEqual(sched.collective_counts(), census, name)
+            if planner.wire_quant_gate() is None:
+                self.assertEqual(quant_steps, 0, name)
+                self.assertIsNone(sched.quant, name)
 
     def test_every_plan_fits_the_budget(self):
         for name, spec in _golden():
@@ -372,9 +389,7 @@ class TestExecutorEquivalence(TestCase):
         """HEAT_TPU_REDIST_PLANNER=0 must bypass the planner and still
         produce correct results."""
         oracle = np.arange(64 * 48, dtype=np.float32).reshape(64, 48)
-        old = os.environ.get("HEAT_TPU_REDIST_PLANNER")
-        os.environ["HEAT_TPU_REDIST_PLANNER"] = "0"
-        try:
+        with env_pin("HEAT_TPU_REDIST_PLANNER", "0"):
             self.assertFalse(planner.planner_enabled())
             x = ht.array(oracle, split=0)
             self.assert_array_equal(x.resplit(1), oracle)
@@ -384,11 +399,6 @@ class TestExecutorEquivalence(TestCase):
             # explain refuses: the plan it would show is not what runs
             with self.assertRaises(RuntimeError):
                 planner.explain(x, 1)
-        finally:
-            if old is None:
-                del os.environ["HEAT_TPU_REDIST_PLANNER"]
-            else:
-                os.environ["HEAT_TPU_REDIST_PLANNER"] = old
         self.assertTrue(planner.planner_enabled())
 
 

@@ -10,11 +10,33 @@ corresponding slice of the oracle — the single-controller equivalent of
 via heat_tpu and numpy over several splits.
 """
 
+import contextlib
+import os
 import unittest
 
 import numpy as np
 
 import heat_tpu as ht
+
+
+@contextlib.contextmanager
+def env_pin(name, value):
+    """Pin one environment gate for a block and restore it on exit —
+    the save/set/restore pattern every gated-feature suite (sort,
+    relayout, overlap, quant) needs. ``value=None`` unsets the var
+    (the gate's default resolution)."""
+    old = os.environ.get(name)
+    try:
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = value
+        yield
+    finally:
+        if old is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = old
 
 
 class TestCase(unittest.TestCase):
