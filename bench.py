@@ -1389,6 +1389,71 @@ def main() -> None:
     except Exception:  # pragma: no cover — the model must never take bench down
         pass
 
+    # two-tier analytic rows (ISSUE 8): no DCN hardware is attached, so
+    # — like dp_step_quant and the MULTICHIP pins — the rows ARE the
+    # checkable model, derived from the planner's tiered plans at a
+    # simulated 2x8 v5e mesh (the 16-chip two-slice production target).
+    try:
+        from heat_tpu.core import communication as _topo_comm
+        from heat_tpu.kernels import quant as _wire_quant
+        from heat_tpu.redistribution import planner as _redist_planner
+        from heat_tpu.redistribution.spec import RedistSpec as _RSpec
+
+        _b28 = _redist_planner.DEFAULT_BUDGET_MB << 20
+        _spec16 = _RSpec.normalize((1000, 250000), "float32", 0, 1, 16)
+        _flat16 = _redist_planner.plan(_spec16, _b28, quant="0", topology="flat")
+        _hier16 = _redist_planner.plan(_spec16, _b28, quant="int8", topology="2x8")
+        # flat baseline: a topology-blind plan's replica groups span
+        # slices, so its whole crossing payload completes at DCN speed
+        _t_flat = _flat16.bytes_moved / _topo_comm.DCN_BPS
+        _tm = _redist_planner.tier_time_model(_hier16)
+        detail["resplit_1gb_2x8_dcn"] = {
+            "modeled": True,
+            "strategy": _hier16.strategy,
+            "plan_id": _hier16.plan_id,
+            "ici_bytes": _tm["ici_bytes"],
+            "dcn_bytes": _tm["dcn_bytes"],
+            "wire_ratio": (
+                round(_hier16.wire_bytes_sent / _hier16.wire_bytes_raw, 4)
+                if _hier16.wire_bytes_raw
+                else 1.0
+            ),
+            "tier_model": {
+                "flat_dcn_ms": round(_t_flat * 1e3, 3),
+                "hier_ici_ms": round(_tm["ici_s"] * 1e3, 3),
+                "hier_dcn_ms": round(_tm["dcn_s"] * 1e3, 3),
+                "hier_total_ms": round(_tm["total_s"] * 1e3, 3),
+            },
+            "tier_model_speedup": round(_t_flat / _tm["total_s"], 3),
+            "method": (
+                "analytic two-tier model: planner plans at topology=2x8 "
+                "(hierarchical-a2a + int8 DCN hop) vs the topology-blind "
+                "flat plan priced at DCN_BPS (no DCN hardware attached)"
+            ),
+        }
+        _dpm2 = _wire_quant.dp_step_model_2tier(
+            400_000_000, compute_s=1e-3, n_slices=2, chips_per_slice=8
+        )
+        detail["dp_step_quant_2x8"] = {
+            "modeled": True,
+            "param_bytes": _dpm2["param_bytes"],
+            "compute_ms": 1.0,
+            "ici_bytes": _dpm2["ici_bytes"],
+            "dcn_bytes": _dpm2["dcn_bytes"],
+            "tier_model": {
+                "flat_f32_ms": round(_dpm2["wire_s_flat"] * 1e3, 3),
+                "hier_int8_ms": round(_dpm2["wire_s_hier"] * 1e3, 3),
+            },
+            "dp_model_speedup": _dpm2["model_speedup"],
+            "method": (
+                "analytic 2x8 two-tier model (kernels.quant."
+                "dp_step_model_2tier): hierarchical+int8 gradient wire vs "
+                "flat+f32 all-reduce at DCN speed"
+            ),
+        }
+    except Exception:  # pragma: no cover — the model must never take bench down
+        pass
+
     # chip rows
     mfu("matmul_bf16_8k", 2 * MM_8K**3)
     mfu("matmul_f32_8k", 2 * MM_8K**3)
@@ -1610,6 +1675,18 @@ def main() -> None:
             "dp_step_quant": (
                 pick("dp_step_quant", "dp_model_speedup", "wire_ratio")
                 if "dp_step_quant" in detail else {}
+            ),
+            # ISSUE 8 two-tier analytic rows (modeled, gated): the
+            # hierarchical-vs-flat speedups and the per-tier byte split
+            # at the simulated 2x8 mesh
+            "resplit_1gb_2x8_dcn": (
+                pick("resplit_1gb_2x8_dcn", "tier_model_speedup", "wire_ratio",
+                     "dcn_bytes", "ici_bytes")
+                if "resplit_1gb_2x8_dcn" in detail else {}
+            ),
+            "dp_step_quant_2x8": (
+                pick("dp_step_quant_2x8", "dp_model_speedup", "dcn_bytes")
+                if "dp_step_quant_2x8" in detail else {}
             ),
             "op_chain": pick("op_chain", "overhead_vs_raw_jnp", "overhead_vs_fused_jnp"),
             "ht_jit_chain": pick("ht_jit_chain", "overhead_vs_fused_jnp") if "ht_jit_chain" in detail else {},

@@ -38,6 +38,10 @@ RULES: Dict[str, str] = {
     "SL106": "host-sync: the checked program reads device values on the host "
              "(jax.device_get / .item() / .numpy() / float(...) on a device "
              "value) — a round-trip that serializes the dispatch pipeline",
+    "SL107": "cross-tier-collective: at a two-tier topology, a flat "
+             "collective whose replica groups span slices ships its whole "
+             "payload at DCN speed — decompose it hierarchically (intra-slice "
+             "pivot + inter-slice exchange; the planner's hierarchical-a2a)",
     "SL201": "host-sync (library): jax.device_get outside a declared host "
              "boundary (analysis/boundaries.py) — new syncs must be declared",
     "SL202": "bare-jit: jax.jit outside a private program builder — public "

@@ -34,6 +34,17 @@ SL105     warning   an output aliases an argument's aval but the buffer
 SL106     error     the program syncs the host (seen in source, or the
                     trace aborts on a concretization error); ambiguous
                     ``int()``/``float()`` casts report as warnings
+SL107     warn/err  cross-tier collective not decomposed (ISSUE 8): at
+                    a two-tier topology, a FLAT collective whose
+                    replica groups (or ppermute source-target pairs)
+                    span slices moves ≥ ``min_bytes`` across DCN — the
+                    whole payload completes at the slow tier. The
+                    sanctioned forms are the planner's
+                    ``hierarchical-a2a`` programs and the hierarchical
+                    DP wire, whose stamped collectives (and the
+                    library's documented ring schedules) downgrade to
+                    info. Evaluated only when a tiered topology is in
+                    effect (``topology=`` arg or ``HEAT_TPU_TOPOLOGY``).
 ========  ========  ====================================================
 
 The contracts the repo already pins stay clean by construction: TSQR's
@@ -136,6 +147,30 @@ def _donated_avals(fn, args, donate_argnums) -> set:
     return donated
 
 
+_REPLICA_GROUPS = re.compile(r"replica_groups=\{((?:\{[0-9, ]*\},?)+)\}")
+_REPLICA_IOTA = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+)\]")
+_SOURCE_TARGETS = re.compile(r"source_target_pairs=\{((?:\{[0-9, ]*\},?)+)\}")
+_GROUP = re.compile(r"\{([0-9, ]*)\}")
+
+
+def _parse_groups(hlo_line: str) -> Optional[list]:
+    """The replica groups (or ppermute source-target pairs) of one HLO
+    collective line, as lists of device ids — ``None`` when the line
+    carries neither form (conservative: no SL107 finding)."""
+    m = _REPLICA_GROUPS.search(hlo_line) or _SOURCE_TARGETS.search(hlo_line)
+    if m:
+        return [
+            [int(v) for v in g.split(",") if v.strip()]
+            for g in _GROUP.findall(m.group(1))
+        ]
+    m = _REPLICA_IOTA.search(hlo_line)
+    if m:
+        rows, cols, total = int(m.group(1)), int(m.group(2)), int(m.group(3))
+        if rows * cols == total:
+            return [list(range(r * cols, (r + 1) * cols)) for r in range(rows)]
+    return None
+
+
 def check(
     fn: Callable,
     *args,
@@ -144,6 +179,7 @@ def check(
     replicate_frac: float = 0.5,
     donate_argnums: Optional[Tuple[int, ...]] = None,
     scan_source: bool = True,
+    topology=None,
     **kwargs,
 ) -> AnalysisReport:
     """Statically analyze the program ``fn(*args, **kwargs)`` compiles to.
@@ -167,6 +203,10 @@ def check(
         donation bookkeeping when present.
     scan_source : also scan ``fn``'s source for host syncs hiding in
         untaken branches (rule SL106).
+    topology : two-tier topology override for rule SL107 (``"SxC"``
+        string, ``core.communication.Topology``, or ``(S, C)`` tuple);
+        the default ``None`` resolves the ambient ``HEAT_TPU_TOPOLOGY``
+        per collective (flat topologies never fire the rule).
 
     Returns an :class:`AnalysisReport`; ``report.ok`` is False iff an
     error-severity finding gates.
@@ -235,7 +275,11 @@ def check(
     context["collective_counts"] = {k: v for k, v in _count_ops(text).items() if v}
 
     # ---- SL101 / SL102: large resharding collectives -------------------
-    from .boundaries import planned_reshard_plan_id, ring_schedule_module
+    from .boundaries import (
+        planned_reshard_plan_id,
+        ring_schedule_module,
+        wire_codec_stamped,
+    )
 
     gather_names: List[Tuple[str, int]] = []
     for m in _COLLECTIVE_LINE.finditer(text):
@@ -316,6 +360,97 @@ def check(
             )
         )
 
+    # ---- SL107: cross-tier collective not decomposed (ISSUE 8) ---------
+    # at a tiered topology, a flat collective whose replica groups span
+    # slices pushes its WHOLE payload across DCN — the planner's
+    # hierarchical-a2a (intra-slice pivot + inter-slice exchange) is the
+    # decomposed form; its stamped programs (and the hierarchical DP
+    # wire) report at info, as do the library's documented ring
+    # schedules. The mesh size comes from the compiled module's own
+    # num_partitions (a subgroup collective's ids can omit the top
+    # devices, so max-id+1 would mis-resolve the topology and silently
+    # skip genuinely DCN-crossing subgroup exchanges); max-id+1 is only
+    # the fallback when the header is absent.
+    from ..core import communication as _communication
+
+    _num_parts = re.search(r"num_partitions=(\d+)", text)
+    _module_n_dev = int(_num_parts.group(1)) if _num_parts else 0
+
+    def _sl107_topology(n_dev: int):
+        if topology is None:
+            return _communication.topology_for(n_dev)
+        return _communication.topology_for(n_dev, topology)
+
+    for m in _COLLECTIVE_LINE.finditer(text):
+        ssa, result_type, op = m.group(1), m.group(2), m.group(3)
+        nbytes = _shaped_bytes(result_type)
+        if nbytes < min_bytes:
+            continue
+        line_end = text.find("\n", m.end())
+        full_line = text[m.start() : len(text) if line_end == -1 else line_end]
+        grps = _parse_groups(full_line)
+        if not grps:
+            continue
+        n_dev = _module_n_dev or (max((i for g in grps for i in g), default=-1) + 1)
+        topo = _sl107_topology(n_dev)
+        if not topo.tiered:
+            continue
+        if op == "collective-permute":
+            spanning = any(len(g) >= 2 and topo.crosses(g[0], g[1]) for g in grps)
+        else:
+            spanning = any(topo.spans(g) for g in grps)
+        if not spanning:
+            continue
+        plan_id = planned_reshard_plan_id(full_line)
+        if plan_id is None and wire_codec_stamped(full_line):
+            plan_id = "wire-codec"
+        if plan_id is not None:
+            findings.append(
+                Finding(
+                    "SL107",
+                    "info",
+                    f"planned cross-tier movement ({plan_id}): {op} crosses "
+                    f"slices at {topo} with ~{nbytes} B ({ssa}) — the "
+                    "decomposed/budgeted DCN hop itself (hierarchical-a2a "
+                    "ships pre-packed per-slice rows; inspect with "
+                    "ht.redistribution.explain)",
+                    op=op,
+                    nbytes=nbytes,
+                )
+            )
+            continue
+        blessed = ring_schedule_module(full_line)
+        if blessed is not None:
+            findings.append(
+                Finding(
+                    "SL107",
+                    "info",
+                    f"documented ring schedule ({blessed}) crosses slices at "
+                    f"{topo}: a {op} ships ~{nbytes} B over DCN on the "
+                    "wraparound edges — the algorithm's block rotation, "
+                    "priced (not flagged) at the tier penalty",
+                    op=op,
+                    nbytes=nbytes,
+                )
+            )
+            continue
+        severity = "error" if nbytes >= err_bytes else "warning"
+        findings.append(
+            Finding(
+                "SL107",
+                severity,
+                f"cross-tier collective not decomposed: a flat {op} whose "
+                f"replica groups span slices at {topo} moves ~{nbytes} B — "
+                "every byte completes at DCN speed (~8x ICI). Decompose it "
+                "hierarchically: intra-slice pivot + inter-slice exchange "
+                "(the redistribution planner's hierarchical-a2a, or "
+                "kernels.quant.hierarchical_allreduce_sum for gradient "
+                "all-reduces)",
+                op=op,
+                nbytes=nbytes,
+            )
+        )
+
     # ---- SL103: all-gather feeding a reduction -------------------------
     # consumer shapes differ by backend: a direct `reduce(`, the CPU
     # `reduce-window` ladder, or a `call` into a %parallel_reduce-*
@@ -378,9 +513,8 @@ def check(
     # compression invites. The sanctioned narrowing is the
     # block-quantized wire codec (kernels/quant.py), whose encode/decode
     # bodies run under jax.named_scope("wire_codec_<mode>"): the stamp
-    # rides the eqn's name_stack, and stamped converts report at info.
-    from .boundaries import wire_codec_stamped
-
+    # rides the eqn's name_stack, and stamped converts report at info
+    # (wire_codec_stamped imported with the SL101 boundary helpers).
     from jax.extend import core as jex_core
 
     collective_prims = {

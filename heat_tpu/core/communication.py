@@ -28,7 +28,11 @@ equivalent — XLA owns layout and transport.
 
 from __future__ import annotations
 
+import dataclasses
 import math
+import os
+import re
+
 import numpy as np
 
 import jax
@@ -42,15 +46,189 @@ from ..observability.instrument import nbytes_of as _nbytes_of
 
 __all__ = [
     "Communication",
+    "DCN_BPS",
+    "DCN_PENALTY",
+    "ICI_BPS",
     "MeshCommunication",
     "MPICommunication",
     "MPI_WORLD",
     "MPI_SELF",
+    "TOPOLOGY_ENV",
+    "Topology",
     "get_comm",
     "use_comm",
     "sanitize_comm",
     "init_distributed",
+    "topology_for",
 ]
+
+
+# --------------------------------------------------------------------- #
+# two-tier topology (ISSUE 8)                                           #
+# --------------------------------------------------------------------- #
+#: per-chip bidirectional ICI bandwidth (v5e, docs/PERF.md multi-chip
+#: analytic model) — the intra-slice tier every earlier PR priced.
+ICI_BPS = 200e9
+
+#: per-chip DCN bandwidth across slices (~8x slower than ICI): the
+#: inter-slice tier multi-slice deployments add. No DCN hardware is
+#: attached to this container — the constant feeds the same analytic
+#: model + HLO-census methodology the multichip work is pinned with.
+DCN_BPS = 25e9
+
+#: cost-model penalty of a DCN-tier byte relative to an ICI-tier byte
+#: (= ICI_BPS / DCN_BPS). The redistribution planner prices tier="dcn"
+#: collective steps with this multiplier so the byte-equivalent cost
+#: scalar keeps one unit.
+DCN_PENALTY = int(ICI_BPS / DCN_BPS)
+
+#: ``HEAT_TPU_TOPOLOGY``: ``auto`` (default — read ``slice_index`` off
+#: the resolved world's devices; single-slice and CPU worlds stay flat),
+#: ``SxC`` (e.g. ``2x8``: force a simulated two-tier factorization of an
+#: S*C-device mesh — slices are assigned to contiguous mesh positions,
+#: matching the slice-major device order ``_resolve_devices`` sorts
+#: into), or ``flat``/``1xN`` (explicitly one ICI domain).
+TOPOLOGY_ENV = "HEAT_TPU_TOPOLOGY"
+
+_TOPOLOGY_RE = re.compile(r"^(\d+)\s*[xX]\s*(\d+)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Two-tier factorization of a 1-D device mesh: ``n_slices`` ICI
+    domains of ``chips_per_slice`` chips each, DCN between them.
+
+    The mesh axis is slice-major (``_resolve_devices`` sorts by
+    ``(slice_index, process, id)``), so slice ``s`` owns the contiguous
+    mesh positions ``[s*chips_per_slice, (s+1)*chips_per_slice)`` and a
+    mesh edge ``a -> b`` stays on ICI iff ``slice_of(a) == slice_of(b)``.
+    ``n_slices == 1`` is the flat single-tier world every PR before
+    ISSUE 8 assumed.
+    """
+
+    n_slices: int
+    chips_per_slice: int
+
+    @property
+    def size(self) -> int:
+        return self.n_slices * self.chips_per_slice
+
+    @property
+    def tiered(self) -> bool:
+        """More than one slice — the DCN tier exists."""
+        return self.n_slices > 1
+
+    def slice_of(self, index: int) -> int:
+        """Slice owning mesh position ``index``."""
+        return int(index) // self.chips_per_slice
+
+    def crosses(self, a: int, b: int) -> bool:
+        """Does the mesh edge ``a -> b`` traverse DCN?"""
+        return self.slice_of(a) != self.slice_of(b)
+
+    def spans(self, indices) -> bool:
+        """Does a replica group of mesh positions span more than one
+        slice (i.e. would a flat collective over it ride DCN)?"""
+        slices = {self.slice_of(i) for i in indices}
+        return len(slices) > 1
+
+    # ---------------------------------------------------------------- #
+    # subgroup helpers (the shard_map axis_index_groups arguments)      #
+    # ---------------------------------------------------------------- #
+    def chip_axis_groups(self) -> List[List[int]]:
+        """Intra-slice groups: one group of ``chips_per_slice``
+        neighbors per slice — collectives over these never cross DCN."""
+        C = self.chips_per_slice
+        return [[s * C + c for c in range(C)] for s in range(self.n_slices)]
+
+    def slice_axis_groups(self) -> List[List[int]]:
+        """Inter-slice groups: the ``chips_per_slice`` groups of
+        same-chip-position peers across slices — the minimal-width DCN
+        exchange pattern (each group carries exactly one chip per
+        slice)."""
+        C = self.chips_per_slice
+        return [[s * C + c for s in range(self.n_slices)] for c in range(C)]
+
+    def bandwidth(self, tier: str) -> float:
+        """Per-chip bytes/s of ``tier`` (``"ici"``/``"dcn"``)."""
+        return {"ici": ICI_BPS, "dcn": DCN_BPS}[tier]
+
+    @classmethod
+    def parse(cls, text: str) -> Optional["Topology"]:
+        """``"2x8"`` -> Topology(2, 8); ``None`` for unparseable text."""
+        m = _TOPOLOGY_RE.match(text.strip())
+        if not m:
+            return None
+        s, c = int(m.group(1)), int(m.group(2))
+        if s < 1 or c < 1:
+            return None
+        return cls(s, c)
+
+    def __str__(self) -> str:
+        return f"{self.n_slices}x{self.chips_per_slice}"
+
+
+def _detect_slices(mesh_size: int) -> Topology:
+    """``auto`` resolution: group the RESOLVED world's devices by
+    ``slice_index`` (TPU pods expose it on multi-slice deployments).
+
+    Reads only ``MPI_WORLD``'s already-resolved device list — never
+    probes the platform itself, so the pure-Python contexts that plan
+    without touching a device (``scripts/redist_plans.py``, golden-plan
+    tests) stay device-free and the one-shot ``init_distributed`` lazy
+    window is preserved. By the time any plan EXECUTES, the world is
+    resolved and a real multi-slice deployment reports its tiers.
+    """
+    devs = MPI_WORLD._devices_  # None until the world resolves
+    if not devs or len(devs) != mesh_size:
+        return Topology(1, mesh_size)
+    counts: dict = {}
+    for d in devs:
+        counts.setdefault(getattr(d, "slice_index", 0) or 0, 0)
+        counts[getattr(d, "slice_index", 0) or 0] += 1
+    sizes = set(counts.values())
+    if len(counts) <= 1 or len(sizes) != 1:
+        # single slice, or ragged slices the 2-tier factorization does
+        # not model: flat (the ragged case cannot arise on real pods)
+        return Topology(1, mesh_size)
+    return Topology(len(counts), next(iter(sizes)))
+
+
+def topology_for(mesh_size: int, override=None) -> Topology:
+    """The :class:`Topology` governing a ``mesh_size``-device mesh.
+
+    ``override`` wins when given: a :class:`Topology`, an ``"SxC"``
+    string, or ``"flat"``. Otherwise ``HEAT_TPU_TOPOLOGY`` decides —
+    ``auto`` (default) reads ``slice_index`` off the resolved world's
+    devices (flat on CPU/single-slice), a forced ``SxC`` simulates that
+    factorization. A forced product that does not equal ``mesh_size``
+    resolves FLAT: a 2x8 setting over an 8-device test mesh must not
+    invent a topology the devices cannot realize (the forced-topology CI
+    leg uses 2x4 on the 8-device mesh for exactly this reason).
+    """
+    mesh_size = int(mesh_size)
+    if override is not None:
+        if isinstance(override, Topology):
+            t = override
+        elif str(override).strip().lower() in ("flat", "1", "none"):
+            return Topology(1, mesh_size)
+        else:
+            t = Topology.parse(str(override))
+            if t is None:
+                raise ValueError(
+                    f"unparseable topology {override!r} (expected 'SxC', "
+                    "'flat', or a Topology)"
+                )
+        return t if t.size == mesh_size and t.tiered else Topology(1, mesh_size)
+    raw = os.environ.get(TOPOLOGY_ENV, "auto").strip().lower()
+    if raw in ("", "auto"):
+        return _detect_slices(mesh_size)
+    if raw in ("flat", "1", "none", "off", "0"):
+        return Topology(1, mesh_size)
+    t = Topology.parse(raw)
+    if t is None or t.size != mesh_size or not t.tiered:
+        return Topology(1, mesh_size)
+    return t
 
 
 class Communication:
@@ -176,6 +354,16 @@ class MeshCommunication(Communication):
     @property
     def devices(self) -> list:
         return list(self._devices)
+
+    @property
+    def topology(self) -> Topology:
+        """The two-tier :class:`Topology` governing this mesh
+        (``HEAT_TPU_TOPOLOGY``; flat on single-slice/CPU worlds). For
+        the world communicator ``auto`` groups the resolved devices by
+        ``slice_index``; sub-communicators of a tiered world resolve
+        flat unless the env forces their factorization (a Split
+        sub-group has no guaranteed slice alignment)."""
+        return topology_for(self.size)
 
     # ------------------------------------------------------------------ #
     # chunk geometry                                                     #

@@ -62,6 +62,25 @@ def _tsqr_group_size(p: int) -> int:
     return best
 
 
+def _tsqr_grouping(p: int, topo=None) -> int:
+    """Level-1 group width ``s`` of the TSQR merge tree (1 = flat
+    single-level). At a TIERED topology (ISSUE 8) the tree groups
+    SLICE-MAJOR: ``s = chips_per_slice``, so every level-0/1 merge —
+    the gathers that carry ``s·K²`` bytes per member — stays inside one
+    ICI domain, and only the ``n_slices`` group-R factors (``G·K²``
+    bytes) cross DCN at level 2. The two-level tree then engages at ANY
+    tiered mesh width, not just ≥ 16: crossing DCN with the full
+    ``p·K²`` flat gather would pay the ~8× tier penalty on ``(p-1)/p``
+    of the bytes for no reason. Flat topologies keep the pre-ISSUE-8
+    rule (√p divisor grouping from 16 devices up) so every pinned
+    census holds verbatim."""
+    if topo is not None:
+        S, C = topo
+        if S > 1 and C > 1 and S * C == p:
+            return C
+    return _tsqr_group_size(p) if p >= _TSQR_TWO_LEVEL_MIN_P else 1
+
+
 # single-level at small meshes (the merge term is noise there and the HLO
 # contract stays one all-gather); two-level from this width up
 _TSQR_TWO_LEVEL_MIN_P = 16
@@ -82,7 +101,7 @@ def _tsqr_ring_active() -> bool:
 @functools.lru_cache(maxsize=128)
 def _tsqr_fn(
     mesh, axis_name: str, lrows: int, cols: int, jdtype: str, calc_q: bool,
-    ring: bool = False,
+    ring: bool = False, topo=None,
 ):
     """Compiled TSQR over the mesh for physical shard shape (lrows, cols).
 
@@ -102,9 +121,14 @@ def _tsqr_fn(
     stacks blocks as they land instead of after the all-gather barrier,
     overlapping the assembly copies (and, on TPU, the local QR epilogue)
     with the wire. Byte-equivalent movement ((size-1)·K·cols per level),
-    identical merge inputs, bit-identical Q/R."""
+    identical merge inputs, bit-identical Q/R.
+
+    ``topo=(S, C)`` (ISSUE 8): slice-major grouping — level-1 groups
+    are exactly the slices (``s = C``), so the heavy gathers never
+    cross DCN and only the tiny cross-group gather (G = n_slices
+    group-Rs) rides the expensive tier."""
     p = mesh.devices.size
-    s = _tsqr_group_size(p) if p >= _TSQR_TWO_LEVEL_MIN_P else 1
+    s = _tsqr_grouping(p, topo)
     two_level = s > 1
     from ...kernels import cmatmul as _cm
 
@@ -219,9 +243,11 @@ def qr(
     if use_tsqr:
         phys = a._phys.astype(jt)
         lrows = phys.shape[0] // comm.size
+        topo_t = comm.topology
         fn = _tsqr_fn(
             comm.mesh, comm.axis_name, lrows, n, np.dtype(jt).name, calc_q,
             ring=_tsqr_ring_active(),
+            topo=(topo_t.n_slices, topo_t.chips_per_slice) if topo_t.tiered else None,
         )
         if calc_q:
             q_phys, r = fn(phys)

@@ -65,7 +65,16 @@ def ring_enabled() -> bool:
     engages them only on the TPU backend: unlike the redistribution
     pipelining (a free reorder), the ring decomposition changes the
     collective pattern, and only TPU's async collective engine turns
-    the per-hop consume into hidden time."""
+    the per-hop consume into hidden time.
+
+    Two-tier audit (ISSUE 8): the ring's ``(s, s+1 mod p)`` neighbor
+    permutation crosses the slice boundary on the wraparound edges of a
+    tiered mesh — EVERY hop then completes at DCN speed, turning the
+    byte-equivalent trade into a (p-1)·(dcn/ici) ≈ 8(p-1)/p loss. Under
+    ``auto`` a tiered topology therefore keeps the barrier collectives
+    (XLA lowers those hierarchically on real multi-slice deployments);
+    the forced ``=1`` leg still runs the rings — they stay
+    bit-identical, only the modeled wire price changes."""
     from ..redistribution import planner as _planner
 
     mode = _planner.overlap_mode()
@@ -73,6 +82,10 @@ def ring_enabled() -> bool:
         return False
     if mode == "1":
         return True
+    from ..core import communication as _comm
+
+    if _comm.get_comm().topology.tiered:
+        return False
     return jax.default_backend() == "tpu"
 
 

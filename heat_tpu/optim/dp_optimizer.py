@@ -256,12 +256,22 @@ class DataParallelOptimizer:
         )
 
     def _get_quant_step(self, xshape, xdtype, yshape, ydtype, n_valid: int):
-        key = (xshape, xdtype, yshape, ydtype, n_valid, self.wire_quant)
+        comm = self.model.comm
+        # two-tier wire (ISSUE 8): at a tiered topology the quantized
+        # all-reduce runs hierarchically — intra-slice reduce-scatter,
+        # inter-slice exchange of the reduced+encoded shard, intra-slice
+        # all-gather — so only ~1/C of the encoded gradient crosses DCN
+        topo_t = comm.topology
+        topo = (
+            (topo_t.n_slices, topo_t.chips_per_slice)
+            if topo_t.tiered and topo_t.chips_per_slice > 1
+            else None
+        )
+        key = (xshape, xdtype, yshape, ydtype, n_valid, self.wire_quant, topo)
         fn = self._step_cache.get(key)
         if fn is not None:
             return fn
         module, loss, tx = self.model.module, self.loss, self.tx
-        comm = self.model.comm
         p, axis = comm.size, comm.axis_name
         mode = self.wire_quant
         import optax
@@ -289,7 +299,12 @@ class DataParallelOptimizer:
             # error feedback: re-inject last step's compression residual,
             # ship the compensated gradient through the quantized wire
             h = g_flat.astype(jnp.float32) + carry_blk[0]
-            red, resid = _quant.quantized_allreduce_sum(h, axis, p, mode)
+            if topo is not None:
+                red, resid = _quant.hierarchical_allreduce_sum(
+                    h, axis, topo[0], topo[1], mode
+                )
+            else:
+                red, resid = _quant.quantized_allreduce_sum(h, axis, p, mode)
             wsum = jax.lax.psum(jnp.sum(w), axis)
             gbar = unravel((red / jnp.maximum(wsum, 1.0)).astype(g_flat.dtype))
             updates, o2 = tx.update(gbar, opt_state, params)

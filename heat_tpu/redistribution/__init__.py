@@ -35,7 +35,13 @@ same census, bit-identical results);
 codec (``heat_tpu.kernels.quant``) — admissible collective groups ship
 int8/bf16 payloads as ``quantize``/``dequantize`` plan steps at a
 pinned numerics tolerance, same census, wire bytes ~quartered (int8) or
-halved (bf16); ``=0`` (and every non-admissible path) is exact-bit.
+halved (bf16); ``=0`` (and every non-admissible path) is exact-bit;
+``HEAT_TPU_TOPOLOGY=auto/SxC/flat`` declares the two-tier topology
+(ISSUE 8) — at a tiered mesh the planner prices each collective's
+bytes per tier (DCN ≈ 8× ICI), decomposes cross-slice all-to-alls into
+the ``hierarchical-a2a`` intra-slice pivot + inter-slice exchange, and
+the codec targets the DCN hop first; unset/flat is byte-identical to
+the pre-topology plans.
 """
 
 from . import executor
@@ -52,6 +58,8 @@ from .planner import (
     overlap_mode,
     plan,
     planner_enabled,
+    resolve_topology,
+    tier_time_model,
     wire_quant_gate,
     wire_quant_mode,
 )
@@ -71,7 +79,9 @@ __all__ = [
     "plan",
     "planner_enabled",
     "reshape_phys",
+    "resolve_topology",
     "resplit_phys",
+    "tier_time_model",
     "wire_quant_gate",
     "wire_quant_mode",
 ]

@@ -41,6 +41,19 @@ reassembly copy it replaces. The codec choice is part of every program
 cache key (a gate flip rebuilds, never reuses), the census is
 unchanged by construction, and with no codec the code paths are
 byte-for-byte the PR 6 forms (the ``=0`` escape hatch is exact-bit).
+
+Two-tier topology (ISSUE 8): a ``hierarchical-a2a`` plan's chunk laps
+run the decomposed exchange — an intra-slice all-to-all over the
+topology's chip subgroups (``axis_index_groups``; the cheap tier
+carries the volume), then an inter-slice all-to-all over the slice
+subgroups shipping only the pre-packed per-slice rows that must cross
+DCN. The received blocks are placed EXACTLY where the flat all-to-all
+would place them, so the output is bit-identical to the flat program
+for any input; the codec (when the plan carries codec steps) engages
+on the inter-slice hop only, the plan's first-target group. The
+topology is part of every program cache key, and with a flat plan
+(``HEAT_TPU_TOPOLOGY`` unset/1xN) the code paths are byte-for-byte
+the PR 7 forms.
 """
 
 from __future__ import annotations
@@ -93,18 +106,21 @@ def _axis_spec(axis_name: str, ndim: int, split: Optional[int]) -> P:
 
 
 def _a2a_chunks(sched: Schedule) -> Tuple[int, int]:
-    """(before, after) all_to_all counts around the plan's ``reshape``
-    step — the chunk counts of the pivot's two collective groups, both
-    structural (a move plan has no reshape step: everything lands in
-    ``before``). The executor re-derives C from the schedule itself so
-    program and plan cannot disagree, and from step KINDS, not the
-    human-readable detail text."""
+    """(before, after) all_to_all LAP counts around the plan's
+    ``reshape`` step — the chunk counts of the pivot's two collective
+    groups, both structural (a move plan has no reshape step: everything
+    lands in ``before``). The executor re-derives C from the schedule
+    itself so program and plan cannot disagree, and from step KINDS, not
+    the human-readable detail text. A hierarchical lap (ISSUE 8) emits
+    an ici + dcn all_to_all PAIR: counting the non-``"ici"`` steps
+    counts each lap once for flat (tier None / ``"dcn"``) and
+    hierarchical plans alike."""
     before = after = 0
     seen_reshape = False
     for st in sched.steps:
         if st.kind == "reshape":
             seen_reshape = True
-        elif st.kind == "all_to_all":
+        elif st.kind == "all_to_all" and st.tier != "ici":
             if seen_reshape:
                 after += 1
             else:
@@ -173,9 +189,21 @@ def _wire_a2a_blocks(chunk, axis_name: str, p: int, s_ax: int, codec: str):
     return lax.all_to_all(wire, axis_name, 0, 0, tiled=True)
 
 
+def _hier_groups(topo: Tuple[int, int]) -> Tuple[list, list]:
+    """(chip_groups, slice_groups) ``axis_index_groups`` of a slice-major
+    two-tier mesh — delegated to ``core.communication.Topology`` so the
+    executor's subgroup structure can never drift from the planner's
+    tier classification."""
+    from ..core.communication import Topology
+
+    t = Topology(*topo)
+    return t.chip_axis_groups(), t.slice_axis_groups()
+
+
 def _chunked_all_to_all(
     x, axis_name: str, p: int, split_axis: int, concat_axis: int, C: int,
     pipelined: bool = False, codec: Optional[str] = None,
+    topo: Optional[Tuple[int, int]] = None,
 ):
     """Tiled all-to-all in C equal chunks along the concat axis, chunk
     results scattered (in place) into the destination-layout buffer.
@@ -201,8 +229,16 @@ def _chunked_all_to_all(
     int8 buffer (census unchanged); ``consume`` decodes and scatters,
     so the full-width dequantize write sits in the consume slot and
     rides under the next lap's wire when pipelined. ``codec=None`` is
-    byte-for-byte the PR 6 program form."""
-    if codec is None:
+    byte-for-byte the PR 6 program form.
+
+    ``topo=(S, C)`` (ISSUE 8) runs each lap HIERARCHICALLY: an
+    intra-slice all-to-all over the chip subgroups redistributes by
+    destination chip (ICI carries the volume), then an inter-slice
+    all-to-all over the slice subgroups ships the pre-packed per-slice
+    rows (minimum DCN bytes; the codec — when given — encodes exactly
+    this hop). The received per-source blocks are placed where the flat
+    all-to-all would place them: bit-identical output by construction."""
+    if topo is None and codec is None:
         if C <= 1:
             return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
     from ..kernels import quant as _quant  # noqa: F401 (codec path only)
@@ -216,7 +252,78 @@ def _chunked_all_to_all(
         d // p if k + 1 == s_ax else d for k, d in enumerate(x2.shape[1:])
     )
 
-    if codec is None:
+    if topo is not None:
+        S_t, C_t = topo
+        g_chip, g_slice = _hier_groups(topo)
+        chunk_shape = (step,) + tuple(x2.shape[1:])
+        B = chunk_shape[s_ax] // p
+        vshape = chunk_shape[:s_ax] + (S_t, C_t, B) + chunk_shape[s_ax + 1 :]
+        # the phase-2 buffer with the S axis moved to front (the wire rows)
+        rest = vshape[:s_ax] + vshape[s_ax + 1 :]
+        n_loc = 1
+        for d in rest:
+            n_loc *= d
+
+        def _phase1(chunk):
+            # destination-flat order (s'·C_t + c') factored as (S, C, B);
+            # phase 1 (ICI): within each slice, destination-chip block c'
+            # goes to chip c'; index c on that axis becomes SOURCE chip
+            return lax.all_to_all(
+                chunk.reshape(vshape), axis_name, s_ax + 1, s_ax + 1,
+                tiled=True, axis_index_groups=g_chip,
+            )
+
+        def _place(out, r, c):
+            # r: (..., p*B at s_ax, ...) in (s_src, c_src)-major order ==
+            # the flat source-device order; place each source block where
+            # the flat all-to-all's scatter puts it
+            for q in range(p):
+                piece = lax.slice_in_dim(r, q * B, (q + 1) * B, axis=s_ax)
+                out = lax.dynamic_update_slice_in_dim(
+                    out, piece, q * Bc + c * step, axis=0
+                )
+            return out
+
+        if codec is None:
+
+            def issue(c):
+                chunk = lax.slice_in_dim(x2, c * step, (c + 1) * step, axis=0)
+                v = _phase1(chunk)
+                # phase 2 (DCN): same-chip peers across slices exchange
+                # the destination-slice rows — already packed per slice,
+                # so only the genuinely crossing bytes travel
+                v = lax.all_to_all(
+                    v, axis_name, s_ax, s_ax, tiled=True,
+                    axis_index_groups=g_slice,
+                )
+                return v.reshape(
+                    chunk_shape[:s_ax] + (p * B,) + chunk_shape[s_ax + 1 :]
+                )
+
+            consume = _place
+
+        else:
+
+            def issue(c):
+                chunk = lax.slice_in_dim(x2, c * step, (c + 1) * step, axis=0)
+                m = jnp.moveaxis(_phase1(chunk), s_ax, 0)
+                wire = _quant.encode_blocks(m.reshape(S_t, n_loc), codec)
+                # the encoded DCN hop; the decode sits in consume so the
+                # full-width dequantize write rides under the next lap's
+                # wire at depth 2, exactly like the flat codec form
+                return lax.all_to_all(
+                    wire, axis_name, 0, 0, tiled=True, axis_index_groups=g_slice
+                )
+
+            def consume(out, w, c):
+                dec = _quant.decode_blocks(w, n_loc, codec).astype(x.dtype)
+                v = jnp.moveaxis(dec.reshape((S_t,) + rest), 0, s_ax)
+                r = v.reshape(
+                    chunk_shape[:s_ax] + (p * B,) + chunk_shape[s_ax + 1 :]
+                )
+                return _place(out, r, c)
+
+    elif codec is None:
 
         def issue(c):
             chunk = lax.slice_in_dim(x2, c * step, (c + 1) * step, axis=0)
@@ -273,7 +380,7 @@ def _packed_flags(sched: Schedule) -> Tuple[bool, bool]:
 
 def _chunked_a2a_flat(
     x, axis_name: str, p: int, C: int, pipelined: bool = False,
-    codec: Optional[str] = None,
+    codec: Optional[str] = None, topo: Optional[Tuple[int, int]] = None,
 ):
     """Tiled all-to-all of a ``(p, M)`` column-grouped FLAT buffer
     (``kernels.relayout.pack_rows`` layout): row d is the block bound
@@ -284,8 +391,12 @@ def _chunked_a2a_flat(
     issue-order contract as :func:`_chunked_all_to_all`). ``codec``
     ships each lap's rows encoded (the buffer is already
     destination-major, so the wire rows ARE its rows); the decode sits
-    in the consume slot."""
-    if codec is None:
+    in the consume slot. ``topo`` runs each lap hierarchically (ISSUE
+    8): the row axis factors as (S, C) destination blocks — intra-slice
+    exchange on the chip factor, inter-slice on the slice factor
+    (codec-encoded when given) — and the received rows land in the same
+    source-major order as the flat form: bit-identical."""
+    if topo is None and codec is None:
         if C <= 1:
             return lax.all_to_all(x, axis_name, 0, 0, tiled=True)
     from ..kernels import quant as _quant  # noqa: F401 (codec path only)
@@ -294,7 +405,51 @@ def _chunked_a2a_flat(
     C = max(C, 1)
     step = M // C
 
-    if codec is None:
+    if topo is not None:
+        S_t, C_t = topo
+        g_chip, g_slice = _hier_groups(topo)
+
+        def _phase1(chunk):
+            # rows (p, step) factored (S, C, step); intra-slice a2a on
+            # the destination-chip factor
+            return lax.all_to_all(
+                chunk.reshape(S_t, C_t, step), axis_name, 1, 1, tiled=True,
+                axis_index_groups=g_chip,
+            )
+
+        if codec is None:
+
+            def issue(c):
+                chunk = lax.slice_in_dim(x, c * step, (c + 1) * step, axis=1)
+                v = lax.all_to_all(
+                    _phase1(chunk), axis_name, 0, 0, tiled=True,
+                    axis_index_groups=g_slice,
+                )
+                return v.reshape(p, step)
+
+            def consume(out, r, c):
+                return lax.dynamic_update_slice_in_dim(out, r, c * step, axis=1)
+
+        else:
+
+            def issue(c):
+                chunk = lax.slice_in_dim(x, c * step, (c + 1) * step, axis=1)
+                wire = _quant.encode_blocks(
+                    _phase1(chunk).reshape(S_t, C_t * step), codec
+                )
+                # encoded DCN hop; decode sits in consume so the
+                # full-width write rides under the next lap's wire
+                return lax.all_to_all(
+                    wire, axis_name, 0, 0, tiled=True, axis_index_groups=g_slice
+                )
+
+            def consume(out, w, c):
+                dec = _quant.decode_blocks(w, C_t * step, codec).astype(x.dtype)
+                return lax.dynamic_update_slice_in_dim(
+                    out, dec.reshape(p, step), c * step, axis=1
+                )
+
+    elif codec is None:
 
         def issue(c):
             chunk = lax.slice_in_dim(x, c * step, (c + 1) * step, axis=1)
@@ -373,17 +528,23 @@ def _ring_exchange(
 @functools.lru_cache(maxsize=512)
 def _move_program(
     comm, spec: RedistSpec, budget: int, pipelined: bool = False,
-    wire: Optional[str] = None,
+    wire: Optional[str] = None, topo: Optional[Tuple[int, int]] = None,
 ):
-    """split i -> split j (all-to-all / chunked / ring) on the physical
-    array: pad dst axis (local) -> shard_map exchange -> drop src-axis
-    pad (local). ``pipelined`` selects the depth-2 prefetch-issue form
-    of the chunk/hop loops (same collectives, bit-identical output) and
-    is part of the program cache key — flipping the
-    ``HEAT_TPU_REDIST_OVERLAP`` gate rebuilds the program. ``wire``
-    (the plan's codec mode, cache-keyed the same way) compiles the
-    encoded-payload loop forms when the plan carries codec steps."""
-    sched = _planner.plan(spec, budget, quant=wire or "0")
+    """split i -> split j (all-to-all / chunked / ring / hierarchical)
+    on the physical array: pad dst axis (local) -> shard_map exchange ->
+    drop src-axis pad (local). ``pipelined`` selects the depth-2
+    prefetch-issue form of the chunk/hop loops (same collectives,
+    bit-identical output) and is part of the program cache key —
+    flipping the ``HEAT_TPU_REDIST_OVERLAP`` gate rebuilds the program.
+    ``wire`` (the plan's codec mode, cache-keyed the same way) compiles
+    the encoded-payload loop forms when the plan carries codec steps.
+    ``topo`` (the plan's topology key, ISSUE 8) compiles the
+    hierarchical exchange when the plan's strategy decomposed across
+    tiers — and pins the internal re-plan to the same topology, so the
+    stamped plan_id always matches the plan the caller executes."""
+    sched = _planner.plan(
+        spec, budget, quant=wire or "0", topology=topo if topo else "flat"
+    )
     mesh, axis_name = comm.mesh, comm.axis_name
     p = spec.mesh_size
     i, j = spec.src_split, spec.dst_split
@@ -392,6 +553,7 @@ def _move_program(
     Nip, Njp = _pad_extent(Ni, p), _pad_extent(Nj, p)
     C = max(_a2a_chunks(sched)[0], 1)
     ring = sched.strategy == "ring"
+    hier = sched.topo_key if sched.strategy == "hierarchical-a2a" else None
     codec, qin, _ = _quant_flags(sched)
     codec = codec if qin else None
 
@@ -403,7 +565,7 @@ def _move_program(
             )
         return _chunked_all_to_all(
             xl, axis_name, p, split_axis=j, concat_axis=i, C=C,
-            pipelined=pipelined, codec=codec,
+            pipelined=pipelined, codec=codec, topo=hier,
         )
 
     mapped = shard_map(
@@ -432,15 +594,18 @@ def _move_program(
 @functools.lru_cache(maxsize=512)
 def _pivot_program(
     comm, spec: RedistSpec, budget: int, pipelined: bool = False,
-    wire: Optional[str] = None,
+    wire: Optional[str] = None, topo: Optional[Tuple[int, int]] = None,
 ):
     """Reshape-with-repartition through the split-0 pivot: all-to-all to
     the flat-contiguous split-0 layout, LOCAL row-major reshape (the
     minor-dim packing copy runs at full width), all-to-all out. Both
     chunk groups run ``pipelined`` as decorated prefetch-issue loops;
     each engages the wire codec independently per the plan's codec
-    steps (``wire`` keys the cache)."""
-    sched = _planner.plan(spec, budget, quant=wire or "0")
+    steps (``wire`` keys the cache); ``topo`` compiles both stage
+    exchanges hierarchically when the plan decomposed across tiers."""
+    sched = _planner.plan(
+        spec, budget, quant=wire or "0", topology=topo if topo else "flat"
+    )
     mesh, axis_name = comm.mesh, comm.axis_name
     p = spec.mesh_size
     s, t = spec.src_split, spec.dst_split
@@ -448,6 +613,7 @@ def _pivot_program(
     ndim_in, ndim_out = len(in_shape), len(out_shape)
     n_in, n_out = _a2a_chunks(sched)
     C1, C2 = max(n_in, 1), max(n_out, 1)
+    hier = sched.topo_key if sched.strategy == "hierarchical-a2a" else None
     codec, qin, qout = _quant_flags(sched)
 
     def body(xl):
@@ -455,7 +621,7 @@ def _pivot_program(
         if s is not None and s != 0:
             y = _chunked_all_to_all(
                 y, axis_name, p, split_axis=0, concat_axis=s, C=C1,
-                pipelined=pipelined, codec=codec if qin else None,
+                pipelined=pipelined, codec=codec if qin else None, topo=hier,
             )
             in_s, in_sp = in_shape[s], _pad_extent(in_shape[s], p)
             if in_sp != in_s:
@@ -470,7 +636,7 @@ def _pivot_program(
                 y = jnp.pad(y, widths)
             y = _chunked_all_to_all(
                 y, axis_name, p, split_axis=t, concat_axis=0, C=C2,
-                pipelined=pipelined, codec=codec if qout else None,
+                pipelined=pipelined, codec=codec if qout else None, topo=hier,
             )
         return y
 
@@ -523,6 +689,7 @@ def _relayout_impls(
 def _packed_pivot_program(
     comm, spec: RedistSpec, budget: int, impl_in, impl_out,
     pipelined: bool = False, wire: Optional[str] = None,
+    topo: Optional[Tuple[int, int]] = None,
 ):
     """The lane-packing pivot (``packed-pivot``): narrow-minor stages
     run on (p, rows·cols/p) column-grouped FLAT buffers so the chunked
@@ -533,7 +700,9 @@ def _packed_pivot_program(
     Same collective census as the direct pivot."""
     from ..kernels import relayout as _relayout
 
-    sched = _planner.plan(spec, budget, quant=wire or "0")
+    sched = _planner.plan(
+        spec, budget, quant=wire or "0", topology=topo if topo else "flat"
+    )
     mesh, axis_name = comm.mesh, comm.axis_name
     p = spec.mesh_size
     s, t = spec.src_split, spec.dst_split
@@ -544,6 +713,7 @@ def _packed_pivot_program(
     n_in, n_out = _a2a_chunks(sched)
     C1, C2 = max(n_in, 1), max(n_out, 1)
     packed_in, packed_out = _packed_flags(sched)
+    hier = sched.topo_key if sched.strategy == "hierarchical-a2a" else None
     codec, qin, qout = _quant_flags(sched)
     codec_in = codec if qin else None
     codec_out = codec if qout else None
@@ -553,13 +723,14 @@ def _packed_pivot_program(
             if packed_in:
                 grouped = xl.reshape(p, R0 * cs0)  # free row-block grouping
                 recv = _chunked_a2a_flat(
-                    grouped, axis_name, p, C1, pipelined=pipelined, codec=codec_in
+                    grouped, axis_name, p, C1, pipelined=pipelined,
+                    codec=codec_in, topo=hier,
                 )
                 flat = _relayout.unpack_rows(recv, R0, c0p, c0, p, impl=impl_in)
             else:
                 y = _chunked_all_to_all(
                     xl, axis_name, p, split_axis=0, concat_axis=1, C=C1,
-                    pipelined=pipelined, codec=codec_in,
+                    pipelined=pipelined, codec=codec_in, topo=hier,
                 )
                 if c0p != c0:
                     y = lax.slice_in_dim(y, 0, c0, axis=1)
@@ -570,7 +741,8 @@ def _packed_pivot_program(
             if packed_out:
                 grouped = _relayout.pack_rows(flat, R1, c1, c1p, p, impl=impl_out)
                 recv = _chunked_a2a_flat(
-                    grouped, axis_name, p, C2, pipelined=pipelined, codec=codec_out
+                    grouped, axis_name, p, C2, pipelined=pipelined,
+                    codec=codec_out, topo=hier,
                 )
                 # rows arrive in global order: the reshape IS the single
                 # lane-amplified materialization of the requested layout
@@ -580,7 +752,7 @@ def _packed_pivot_program(
                 y = jnp.pad(y, ((0, 0), (0, c1p - c1)))
             return _chunked_all_to_all(
                 y, axis_name, p, split_axis=1, concat_axis=0, C=C2,
-                pipelined=pipelined, codec=codec_out,
+                pipelined=pipelined, codec=codec_out, topo=hier,
             )
         return flat.reshape(R1, c1)
 
@@ -600,14 +772,18 @@ def _packed_pivot_program(
 
 
 @functools.lru_cache(maxsize=512)
-def _gather_reshape_program(comm, spec: RedistSpec, budget: int):
+def _gather_reshape_program(
+    comm, spec: RedistSpec, budget: int, topo: Optional[Tuple[int, int]] = None
+):
     """The explicit fallback: replicate the physical operand (ONE
     all-gather), drop pads, reshape, re-pad and slice out the dst shard.
     Also serves the replicated-source reshape (no gather: the constraint
-    on an already-replicated operand is a no-op)."""
+    on an already-replicated operand is a no-op). ``topo`` only pins the
+    internal re-plan (the tier annotation changes the stamped plan_id,
+    never the program form — a full gather spans slices either way)."""
     from ..core import _padding
 
-    sched = _planner.plan(spec, budget)
+    sched = _planner.plan(spec, budget, topology=topo if topo else "flat")
     mesh, axis_name = comm.mesh, comm.axis_name
     s, t = spec.src_split, spec.dst_split
     out_shape = spec.out_shape
@@ -629,7 +805,9 @@ def _gather_reshape_program(comm, spec: RedistSpec, budget: int):
 @functools.lru_cache(maxsize=512)
 def _local_reshape_program(comm, spec: RedistSpec, budget: int):
     """Zero-collective reshape paths: 1-device meshes and replicated
-    sources (the dst distribution is a local slice)."""
+    sources (the dst distribution is a local slice). No topo key: a
+    collective-free plan carries no tier annotation, so its plan_id is
+    topology-independent by construction."""
     from ..core import _padding
 
     sched = _planner.plan(spec, budget)
@@ -703,13 +881,15 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
         sched = _planner.plan(spec)
     else:
         # the program builders compile the PLANNER's schedule for
-        # (spec, budget, codec) — a hand-built/modified Schedule would
-        # be silently ignored, so refuse it instead (a caller-provided
-        # sched pins ITS codec: passing a quantized plan executes the
-        # codec program regardless of the ambient gate)
+        # (spec, budget, codec, topology) — a hand-built/modified
+        # Schedule would be silently ignored, so refuse it instead (a
+        # caller-provided sched pins ITS codec AND topology: passing a
+        # quantized or tiered plan executes that program regardless of
+        # the ambient gates)
         planned = _planner.plan(
             spec, sched.budget_bytes,
             quant=sched.quant["mode"] if sched.quant else "0",
+            topology=sched.topo_key if sched.topo_key else "flat",
         )
         if planned.plan_id != sched.plan_id:
             raise ValueError(
@@ -723,6 +903,7 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
     strategy = sched.strategy
     budget = sched.budget_bytes
     wire = sched.quant["mode"] if sched.quant else None
+    topo = sched.topo_key
     # a program only HAS a pipelined issue order when the plan carries
     # tagged laps (chunk groups / ring hops): single-collective plans and
     # the barrier strategies (replicate/gather-reshape/local-reshape)
@@ -742,6 +923,11 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
             _telemetry.inc("redist.wire.bytes_raw", raw)
             _telemetry.inc("redist.wire.bytes_sent", sent)
             _telemetry.inc("redist.wire.saved", raw - sent)
+        if topo is not None and sched.n_collectives:
+            # per-tier wire accounting (ISSUE 8)
+            tb = sched.tier_bytes()
+            _telemetry.inc("redist.tier.ici_bytes", tb["ici"])
+            _telemetry.inc("redist.tier.dcn_bytes", tb["dcn"])
     if strategy == "noop":
         return phys
     if strategy in ("slice",) or (strategy == "local" and not spec.is_reshape):
@@ -751,13 +937,32 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
     if strategy == "replicate":
         # the explicit full all-gather runs as a stamped program too, so
         # its SL102 finding reports as info with the plan id attached
-        return _gather_reshape_program(comm, spec, budget)(phys)
+        return _gather_reshape_program(comm, spec, budget, topo)(phys)
     if strategy in ("all-to-all", "chunked-all-to-all", "ring"):
-        return _move_program(comm, spec, budget, pipelined, wire)(phys)
+        return _move_program(comm, spec, budget, pipelined, wire, topo)(phys)
+    if strategy == "hierarchical-a2a":
+        # the tiered decomposition (ISSUE 8): pivot-family when the plan
+        # carries a reshape step, plain move otherwise; packed when the
+        # plan carries pack/unpack steps — all re-derived from step
+        # KINDS so program and plan cannot disagree
+        if spec.is_reshape:
+            if any(st.kind in ("pack", "unpack") for st in sched.steps):
+                if _telemetry._ENABLED:
+                    _telemetry.inc("redist.relayout.packed")
+                impl_in, impl_out = _relayout_impls(
+                    spec, sched, concrete=not isinstance(phys, jax.core.Tracer)
+                )
+                return _packed_pivot_program(
+                    comm, spec, budget, impl_in, impl_out, pipelined, wire, topo
+                )(phys)
+            if _telemetry._ENABLED:
+                _telemetry.inc("redist.relayout.direct")
+            return _pivot_program(comm, spec, budget, pipelined, wire, topo)(phys)
+        return _move_program(comm, spec, budget, pipelined, wire, topo)(phys)
     if strategy == "split0-pivot":
         if _telemetry._ENABLED:
             _telemetry.inc("redist.relayout.direct")
-        return _pivot_program(comm, spec, budget, pipelined, wire)(phys)
+        return _pivot_program(comm, spec, budget, pipelined, wire, topo)(phys)
     if strategy == "packed-pivot":
         if _telemetry._ENABLED:
             _telemetry.inc("redist.relayout.packed")
@@ -765,14 +970,14 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
             spec, sched, concrete=not isinstance(phys, jax.core.Tracer)
         )
         return _packed_pivot_program(
-            comm, spec, budget, impl_in, impl_out, pipelined, wire
+            comm, spec, budget, impl_in, impl_out, pipelined, wire, topo
         )(phys)
     if strategy == "gather-reshape":
-        return _gather_reshape_program(comm, spec, budget)(phys)
+        return _gather_reshape_program(comm, spec, budget, topo)(phys)
     if strategy in ("local-reshape", "local"):
         if spec.src_split == 0 and spec.dst_split == 0 and spec.mesh_size > 1:
             # divisible split-0 <-> split-0: device blocks stay put
-            return _pivot_program(comm, spec, budget, pipelined, wire)(phys)
+            return _pivot_program(comm, spec, budget, pipelined, wire, topo)(phys)
         return _local_reshape_program(comm, spec, budget)(phys)
     raise ValueError(f"unknown strategy {strategy!r} (plan {sched.plan_id})")
 

@@ -87,8 +87,29 @@ numerics-tolerance policy: float32 transient exchanges of at least
 small moves, the materializing replicate/gather strategies) ships
 exact-bit under every gate value.
 
-Plans are cached per ``(spec, budget, codec)`` and feed the PR-1
-telemetry registry: ``redist.plan_cache.{hit,miss}``,
+Two-tier topology (ISSUE 8): at a tiered topology
+(``HEAT_TPU_TOPOLOGY``, ``core.communication.Topology`` — ``auto``
+reads ``slice_index`` off the resolved world, ``SxC`` forces a
+simulated factorization) every candidate is priced per tier: a flat
+collective whose replica groups span slices rides DCN (its steps carry
+``tier="dcn"`` and cost ``DCN_PENALTY`` ≈ 8× per byte — the slowest
+edge in the group governs the collective), and a new
+``hierarchical-a2a`` strategy decomposes each cross-slice all-to-all
+into an intra-slice pivot (the cheap tier carries the volume,
+``L·(C-1)/C`` on ICI) plus an inter-slice exchange of pre-packed
+per-slice rows (the expensive tier ships only the bytes that must
+cross, ``L·(S-1)/S`` — the portable-redistribution factorization of
+arXiv:2112.01075 applied across tiers). The DCN group is the first
+group the wire codec targets: in hierarchical plans the admissibility
+policy quantizes ONLY the ``tier="dcn"`` exchanges (the ICI hop is
+wire-cheap and stays exact, halving the codec error for free). Tier
+annotations and the schedule-level ``topology`` annotation fold into
+the canonical serialization and ``plan_id``; with the topology unset or
+``1xN`` no annotation exists and every plan is byte-identical to the
+pre-topology era.
+
+Plans are cached per ``(spec, budget, codec, topology)`` and feed the
+PR-1 telemetry registry: ``redist.plan_cache.{hit,miss}``,
 ``redist.planned_bytes``, ``redist.steps``, ``redist.peak_bytes``.
 """
 
@@ -119,6 +140,8 @@ __all__ = [
     "overlap_mode",
     "plan",
     "planner_enabled",
+    "resolve_topology",
+    "tier_time_model",
     "wire_quant_gate",
     "wire_quant_mode",
 ]
@@ -158,6 +181,7 @@ QUANT_MIN_WIRE_BYTES = 2 << 20
 #: array values compute then consumes, so they stay exact-bit always.
 _QUANT_STRATEGIES = (
     "all-to-all", "chunked-all-to-all", "ring", "split0-pivot", "packed-pivot",
+    "hierarchical-a2a",
 )
 
 _plan_lock = threading.Lock()
@@ -227,6 +251,58 @@ def wire_quant_gate() -> Optional[str]:
     import jax
 
     return "int8" if jax.default_backend() == "tpu" else None
+
+
+def _dcn_penalty() -> int:
+    from ..core import communication as _comm
+
+    return _comm.DCN_PENALTY
+
+
+def resolve_topology(mesh_size: int, override=None) -> Optional[Tuple[int, int]]:
+    """``(n_slices, chips_per_slice)`` of the TIERED topology governing
+    a ``mesh_size`` mesh, or ``None`` when flat (one ICI domain — every
+    pre-ISSUE-8 plan). ``override``: ``None`` resolves the ambient
+    ``HEAT_TPU_TOPOLOGY`` (``auto`` on the resolved world's
+    ``slice_index``), ``"flat"`` forces flat, an ``"SxC"`` string /
+    ``Topology`` / ``(S, C)`` tuple forces that factorization (falling
+    back to flat when the product does not equal ``mesh_size``)."""
+    if isinstance(override, tuple):
+        S, C = int(override[0]), int(override[1])
+        return (S, C) if S > 1 and S * C == int(mesh_size) else None
+    from ..core import communication as _comm
+
+    t = _comm.topology_for(mesh_size, override)
+    return (t.n_slices, t.chips_per_slice) if t.tiered else None
+
+
+def _topo_annotation(topo: Tuple[int, int]) -> dict:
+    return {
+        "n_slices": int(topo[0]),
+        "chips_per_slice": int(topo[1]),
+        "dcn_penalty": _dcn_penalty(),
+    }
+
+
+def tier_time_model(sched: Schedule) -> dict:
+    """Analytic per-device wall-time split of a plan's collective
+    payload over the two tiers at the v5e constants
+    (``core.communication.ICI_BPS``/``DCN_BPS``) — the checkable model
+    the ``*_2x8_dcn`` bench rows report (no DCN hardware is attached;
+    this is the MULTICHIP methodology). Flat plans price everything at
+    ICI."""
+    from ..core import communication as _comm
+
+    tb = sched.tier_bytes()
+    ici_s = tb["ici"] / _comm.ICI_BPS
+    dcn_s = tb["dcn"] / _comm.DCN_BPS
+    return {
+        "ici_bytes": tb["ici"],
+        "dcn_bytes": tb["dcn"],
+        "ici_s": ici_s,
+        "dcn_s": dcn_s,
+        "total_s": ici_s + dcn_s,
+    }
 
 
 def budget_bytes() -> int:
@@ -485,8 +561,194 @@ def _a2a_group(tag: str, L: int, p: int, C: int, lane_fill: float) -> Optional[d
     return _overlap_group(tag, C, int(crossing / fill), int(L / fill))
 
 
-def _resplit_candidates(spec: RedistSpec, budget: int) -> List[Schedule]:
-    """split i -> split j candidates: (chunked) all-to-all and the ring."""
+# --------------------------------------------------------------------- #
+# two-tier topology (ISSUE 8): tier classification + hierarchical a2a   #
+# --------------------------------------------------------------------- #
+def _tier_group(
+    tag: str, laps: int, ici_bytes: int, dcn_bytes: int, copy_bytes: int
+) -> Optional[dict]:
+    """Critical-path model of one pipelined chunk group at a TIERED
+    topology: a lap's ICI hop, its (penalty-priced) DCN hop, and the
+    reassembly copy each occupy a different engine, so the depth-2
+    steady state prices a lap at ``max(ici, dcn·penalty, copy)`` with
+    the first wire legs and last copy exposed. ``wire_bytes`` is kept in
+    ICI byte-equivalents (``ici + dcn·penalty``) so the schedule-level
+    ``sequential_model_bytes``/``critical_path_bytes`` arithmetic is
+    unit-consistent with the flat groups."""
+    laps = int(laps)
+    if laps < 2:
+        return None
+    pen = _dcn_penalty()
+    ici_bytes, dcn_bytes, copy_bytes = int(ici_bytes), int(dcn_bytes), int(copy_bytes)
+    wi, wd, c = ici_bytes // laps, dcn_bytes * pen // laps, copy_bytes // laps
+    cp = wi + wd + c + (laps - 1) * max(wi, wd, c)
+    wire_eq = ici_bytes + dcn_bytes * pen
+    seq = wire_eq + copy_bytes
+    if cp >= seq:
+        return None
+    return {
+        "tag": tag,
+        "laps": laps,
+        "wire_bytes": int(wire_eq),
+        "copy_bytes": copy_bytes,
+        "ici_bytes": ici_bytes,
+        "dcn_bytes": dcn_bytes,
+        "dcn_penalty": pen,
+        "sequential_bytes": int(seq),
+        "critical_path_bytes": int(cp),
+    }
+
+
+def _hier_a2a_group(
+    tag: str, L: int, topo: Tuple[int, int], laps: int, lane_fill: float
+) -> Optional[dict]:
+    """Tier group for a ``laps``-lap hierarchical all-to-all of ``L``
+    local bytes at topology ``(S, C)``: the intra-slice pivot carries
+    ``L·(C-1)/C`` on ICI, the inter-slice exchange ``L·(S-1)/S`` on
+    DCN, and the scatter reassembly writes ``L``."""
+    S, C = topo
+    fill = max(float(lane_fill), 1e-9)
+    return _tier_group(
+        tag,
+        laps,
+        int(L * (C - 1) // C / fill),
+        int(L * (S - 1) // S / fill),
+        int(L / fill),
+    )
+
+
+def _with_tier(st: Step, tier: str) -> Step:
+    return Step(
+        st.kind,
+        bytes_moved=st.bytes_moved,
+        peak_bytes=st.peak_bytes,
+        detail=st.detail,
+        chunk=st.chunk,
+        bytes_copied=st.bytes_copied,
+        lane_fill=st.lane_fill,
+        overlap=st.overlap,
+        tier=tier,
+    )
+
+
+def _tier_flat(sched: Schedule, topo: Optional[Tuple[int, int]]) -> Schedule:
+    """Classify a FLAT-structure candidate at a tiered topology: its
+    replica groups span the whole mesh, so every collective rides DCN —
+    each collective step gains ``tier="dcn"`` (the cost model then
+    prices its bytes at the penalty) and the schedule carries the
+    topology annotation. Structure, census, and executor program form
+    are unchanged — only the price and the serialization."""
+    if topo is None or not any(st.is_collective for st in sched.steps):
+        return sched
+    steps = [_with_tier(st, "dcn") if st.is_collective else st for st in sched.steps]
+    overlap = sched.overlap
+    if overlap:
+        rebuilt = [
+            _tier_group(g["tag"], g["laps"], 0, g["wire_bytes"], g["copy_bytes"])
+            for g in overlap["groups"]
+        ]
+        overlap = _overlap_annotation(rebuilt)
+    return Schedule(
+        sched.spec,
+        sched.strategy,
+        steps,
+        sched.budget_bytes,
+        notes=sched.notes,
+        overlap=overlap,
+        quant=sched.quant,
+        topology=_topo_annotation(topo),
+    )
+
+
+def _hier_chunk_steps(
+    L: int,
+    topo: Tuple[int, int],
+    K: int,
+    what: str,
+    pad_step: Optional[Step],
+    tail_slice: Optional[Step],
+    lane_fill: float = 1.0,
+    pipe: Optional[str] = None,
+) -> List[Step]:
+    """The hierarchical counterpart of :func:`_a2a_chunk_steps`: K laps
+    of slice → intra-slice all-to-all (chip subgroups, the cheap tier
+    carries the volume) → inter-slice all-to-all of pre-packed per-slice
+    rows (the expensive tier ships only the bytes that must cross) →
+    scatter reassembly. Census: 2·K all-to-alls, tiers ici/dcn."""
+    S, C = topo
+    steps: List[Step] = []
+    if pad_step is not None:
+        steps.append(pad_step)
+    ici_cross = L * (C - 1) // C
+    dcn_cross = L * (S - 1) // S
+    pipe = pipe if K > 1 else None  # single-lap: nothing to pipeline
+
+    def lap(chunk: Optional[int], l_bytes: int):
+        out = []
+        if chunk is not None:
+            out.append(
+                Step(
+                    "slice",
+                    peak_bytes=l_bytes,
+                    detail=f"chunk {chunk}/{K} of {what}",
+                    chunk=chunk,
+                    overlap=pipe,
+                )
+            )
+        out.append(
+            Step(
+                "all_to_all",
+                bytes_moved=ici_cross // max(K, 1),
+                peak_bytes=2 * l_bytes,
+                detail=f"intra-slice pivot of {what} (chip subgroups)",
+                chunk=chunk,
+                lane_fill=lane_fill,
+                overlap=pipe,
+                tier="ici",
+            )
+        )
+        out.append(
+            Step(
+                "all_to_all",
+                bytes_moved=dcn_cross // max(K, 1),
+                peak_bytes=2 * l_bytes,
+                detail=(
+                    f"inter-slice exchange of {what} (pre-packed per-slice "
+                    "rows — minimum DCN bytes)"
+                ),
+                chunk=chunk,
+                lane_fill=lane_fill,
+                overlap=pipe,
+                tier="dcn",
+            )
+        )
+        return out
+
+    if K <= 1:
+        steps += lap(None, L)
+    else:
+        for c in range(K):
+            steps += lap(c, L // K)
+        steps.append(
+            Step(
+                "concat",
+                peak_bytes=0,
+                detail="scatter chunks into dst shard",
+                overlap=pipe,
+            )
+        )
+    if tail_slice is not None:
+        steps.append(tail_slice)
+    return steps
+
+
+def _resplit_candidates(
+    spec: RedistSpec, budget: int, topo: Optional[Tuple[int, int]] = None
+) -> List[Schedule]:
+    """split i -> split j candidates: (chunked) all-to-all and the ring
+    — plus, at a tiered topology, the ``hierarchical-a2a`` decomposition
+    (and the flat forms DCN-classified, since their replica groups span
+    slices)."""
     p = spec.mesh_size
     i, j = spec.src_split, spec.dst_split
     L = _local_move_bytes(spec)
@@ -556,7 +818,30 @@ def _resplit_candidates(spec: RedistSpec, budget: int) -> List[Schedule]:
         notes="p-1 ppermute hops, one neighbor block in flight per step",
         overlap=_overlap_annotation([ring_group]),
     )
-    return [a2a, ring]
+    if topo is None:
+        return [a2a, ring]
+    # tiered topology: the flat forms span slices (every collective —
+    # including each +d ring hop, whose wraparound neighbors cross the
+    # slice boundary — rides DCN at the penalty price), and the
+    # hierarchical decomposition competes
+    hier_steps = _hier_chunk_steps(
+        L, topo, C, what, pad_step, tail, lane_fill=fill, pipe="pipe0"
+    )
+    hier = Schedule(
+        spec,
+        "hierarchical-a2a",
+        hier_steps,
+        budget,
+        notes=(
+            f"two-tier decomposition at {topo[0]}x{topo[1]}: intra-slice "
+            "pivot (ICI carries the volume) + inter-slice exchange of "
+            "pre-packed per-slice rows (minimum DCN bytes)"
+            + (f"; C={C} chunks" if C > 1 else "")
+        ),
+        overlap=_overlap_annotation([_hier_a2a_group("pipe0", L, topo, C, fill)]),
+        topology=_topo_annotation(topo),
+    )
+    return [_tier_flat(a2a, topo), _tier_flat(ring, topo), hier]
 
 
 def _pivot_valid(spec: RedistSpec) -> bool:
@@ -576,13 +861,28 @@ def _pivot_valid(spec: RedistSpec) -> bool:
     )
 
 
-def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
+def _pivot_schedule(
+    spec: RedistSpec, budget: int, topo: Optional[Tuple[int, int]] = None
+) -> Schedule:
+    """The split-0 pivot. ``topo`` builds the HIERARCHICAL variant
+    (ISSUE 8): each stage exchange decomposes into the intra-slice +
+    inter-slice pair, the strategy is named ``hierarchical-a2a``, and
+    the overlap groups price laps at ``max(ici, dcn·penalty, copy)``."""
     p = spec.mesh_size
     s, t = spec.src_split, spec.dst_split
     item = spec.itemsize
     steps: List[Step] = []
     groups: List[Optional[dict]] = []
     shard = spec.size // p * item  # logical bytes per device block
+
+    def stage(L, C, what, fill, pipe):
+        if topo is None:
+            groups.append(_a2a_group(pipe, L, p, C, fill) if C > 1 else None)
+            return _a2a_chunk_steps(
+                L, p, C, what, None, None, lane_fill=fill, pipe=pipe
+            )
+        groups.append(_hier_a2a_group(pipe, L, topo, C, fill))
+        return _hier_chunk_steps(L, topo, C, what, None, None, lane_fill=fill, pipe=pipe)
 
     n_coll = 0
     if s is not None and s != 0:
@@ -591,11 +891,7 @@ def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
         ) // p * item
         C1 = _lap_count(_pad_extent(spec.gshape[s], p) // p, L1, budget)
         fill_in = _exchange_fill(spec.gshape, s, 0, p)
-        steps += _a2a_chunk_steps(
-            L1, p, C1, f"split {s}->0 (pivot in)", None, None,
-            lane_fill=fill_in, pipe="pipe0",
-        )
-        groups.append(_a2a_group("pipe0", L1, p, C1, fill_in) if C1 > 1 else None)
+        steps += stage(L1, C1, f"split {s}->0 (pivot in)", fill_in, "pipe0")
         n_coll += C1
         if _pad_extent(spec.gshape[s], p) != spec.gshape[s]:
             steps.append(
@@ -631,20 +927,25 @@ def _pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
             )
         C2 = _lap_count(spec.out_shape[0] // p, L2, budget)
         fill_out = _exchange_fill(spec.out_shape, 0, t, p)
-        steps += _a2a_chunk_steps(
-            L2, p, C2, f"split 0->{t} (pivot out)", None, None,
-            lane_fill=fill_out, pipe="pipe1",
-        )
-        groups.append(_a2a_group("pipe1", L2, p, C2, fill_out) if C2 > 1 else None)
+        steps += stage(L2, C2, f"split 0->{t} (pivot out)", fill_out, "pipe1")
         n_coll += C2
-    strategy = "split0-pivot" if n_coll else "local-reshape"
+    if n_coll:
+        strategy = "hierarchical-a2a" if topo is not None else "split0-pivot"
+    else:
+        strategy = "local-reshape"
     return Schedule(
         spec,
         strategy,
         steps,
         budget,
-        notes="minor-dim packing: heavy copies run on the split-0 layout",
+        notes="minor-dim packing: heavy copies run on the split-0 layout"
+        + (
+            f"; two-tier pivot stages at {topo[0]}x{topo[1]}"
+            if topo is not None and n_coll
+            else ""
+        ),
         overlap=_overlap_annotation(groups),
+        topology=_topo_annotation(topo) if topo is not None and n_coll else None,
     )
 
 
@@ -667,14 +968,18 @@ def _packed_sides(spec: RedistSpec) -> Tuple[bool, bool]:
     return packed_in, packed_out
 
 
-def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
+def _packed_pivot_schedule(
+    spec: RedistSpec, budget: int, topo: Optional[Tuple[int, int]] = None
+) -> Schedule:
     """The split-0 pivot with its narrow-minor stages rewritten on
     lane-packed buffers (``heat_tpu.kernels.relayout``): the chunked
     all-to-alls stream (p, rows·cols/p) column-grouped FLAT buffers
     (full VREGs), and the only lane-amplified copy left is the single
     unpack that materializes the destination's requested narrow layout.
     Same collective census as the direct pivot — the packing changes
-    layouts, never movement."""
+    layouts, never movement. ``topo`` builds the hierarchical variant
+    (strategy ``hierarchical-a2a``): the packed flat buffers decompose
+    across tiers exactly like the direct ones."""
     p = spec.mesh_size
     item = spec.itemsize
     s, t = spec.src_split, spec.dst_split
@@ -686,15 +991,20 @@ def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
     steps: List[Step] = []
     groups: List[Optional[dict]] = []
 
+    def stage(L, C, what, fill, pipe):
+        if topo is None:
+            groups.append(_a2a_group(pipe, L, p, C, fill) if C > 1 else None)
+            return _a2a_chunk_steps(
+                L, p, C, what, None, None, lane_fill=fill, pipe=pipe
+            )
+        groups.append(_hier_a2a_group(pipe, L, topo, C, fill))
+        return _hier_chunk_steps(L, topo, C, what, None, None, lane_fill=fill, pipe=pipe)
+
     if s == 1:
         L1 = r0 * c0p // p * item
         C1 = _lap_count(c0p // p, L1, budget)
         if packed_in:
-            steps += _a2a_chunk_steps(
-                L1, p, C1, "split 1->0 (packed pivot in)", None, None,
-                lane_fill=1.0, pipe="pipe0",
-            )
-            groups.append(_a2a_group("pipe0", L1, p, C1, 1.0) if C1 > 1 else None)
+            steps += stage(L1, C1, "split 1->0 (packed pivot in)", 1.0, "pipe0")
             steps.append(
                 Step(
                     "unpack",
@@ -709,11 +1019,7 @@ def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
             )
         else:
             fill_in = _exchange_fill(spec.gshape, 1, 0, p)
-            steps += _a2a_chunk_steps(
-                L1, p, C1, f"split {s}->0 (pivot in)", None, None,
-                lane_fill=fill_in, pipe="pipe0",
-            )
-            groups.append(_a2a_group("pipe0", L1, p, C1, fill_in) if C1 > 1 else None)
+            steps += stage(L1, C1, f"split {s}->0 (pivot in)", fill_in, "pipe0")
             if c0p != c0:
                 steps.append(
                     Step("slice", peak_bytes=shard, detail="drop axis 1 pad (local)")
@@ -742,11 +1048,7 @@ def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
                     ),
                 )
             )
-            steps += _a2a_chunk_steps(
-                L2, p, C2, "split 0->1 (packed pivot out)", None, None,
-                lane_fill=1.0, pipe="pipe1",
-            )
-            groups.append(_a2a_group("pipe1", L2, p, C2, 1.0) if C2 > 1 else None)
+            steps += stage(L2, C2, "split 0->1 (packed pivot out)", 1.0, "pipe1")
             steps.append(
                 Step(
                     "unpack",
@@ -771,21 +1073,23 @@ def _packed_pivot_schedule(spec: RedistSpec, budget: int) -> Schedule:
                     )
                 )
             fill_out = _exchange_fill(spec.out_shape, 0, 1, p)
-            steps += _a2a_chunk_steps(
-                L2, p, C2, f"split 0->{t} (pivot out)", None, None,
-                lane_fill=fill_out, pipe="pipe1",
-            )
-            groups.append(_a2a_group("pipe1", L2, p, C2, fill_out) if C2 > 1 else None)
+            steps += stage(L2, C2, f"split 0->{t} (pivot out)", fill_out, "pipe1")
     return Schedule(
         spec,
-        "packed-pivot",
+        "hierarchical-a2a" if topo is not None else "packed-pivot",
         steps,
         budget,
         notes=(
             "lane-packing pivot: collectives and heavy copies run on packed "
             "full-lane buffers (HEAT_TPU_RELAYOUT_KERNEL gates the tiled-copy kernel)"
+        )
+        + (
+            f"; two-tier pivot stages at {topo[0]}x{topo[1]}"
+            if topo is not None
+            else ""
         ),
         overlap=_overlap_annotation(groups),
+        topology=_topo_annotation(topo) if topo is not None else None,
     )
 
 
@@ -838,11 +1142,19 @@ def _gather_reshape_schedule(spec: RedistSpec, budget: int) -> Schedule:
 def _cost(s: Schedule) -> int:
     """Byte-equivalent cost: ALPHA per collective launch, plus every
     step's lane-amplified HBM traffic (payload + local relayout copy
-    writes, divided by the step's VREG lane fill)."""
-    return sum(
-        (ALPHA_BYTES if st.is_collective else 0) + st.effective_bytes
-        for st in s.steps
-    )
+    writes, divided by the step's VREG lane fill). A ``tier="dcn"``
+    collective's bytes are priced at ``DCN_PENALTY`` (≈ 8×, the
+    ICI/DCN bandwidth ratio) — the tier term that makes
+    ``hierarchical-a2a`` beat the slice-spanning flat forms exactly on
+    the big cross-slice moves (ISSUE 8)."""
+    pen = _dcn_penalty() if s.topology else 1
+    total = 0
+    for st in s.steps:
+        eff = st.effective_bytes
+        if st.tier == "dcn":
+            eff *= pen
+        total += (ALPHA_BYTES if st.is_collective else 0) + eff
+    return total
 
 
 def _select(candidates: List[Schedule]) -> Schedule:
@@ -859,7 +1171,7 @@ def _select(candidates: List[Schedule]) -> Schedule:
     )
     return Schedule(
         best.spec, best.strategy, best.steps, best.budget_bytes,
-        notes=notes, overlap=best.overlap,
+        notes=notes, overlap=best.overlap, topology=best.topology,
     )
 
 
@@ -883,7 +1195,15 @@ def _quantize_schedule(sched: Schedule, mode: Optional[str]) -> Schedule:
     exchanges are latency-bound and stay exact). The overlap groups'
     critical-path models are rebuilt on the encoded wire bytes — the
     codec shrinks the ``wire`` leg of ``max(wire, copy)``, which is
-    exactly the ICI-bound rows' binding term."""
+    exactly the ICI-bound rows' binding term.
+
+    Tiered plans (ISSUE 8): in a ``hierarchical-a2a`` plan only the
+    ``tier="dcn"`` exchanges are codec-eligible — the inter-slice hop
+    is the wire-bound leg the decomposition isolated, and it is the
+    FIRST group the codec targets; the intra-slice pivot is wire-cheap
+    and stays exact (half the codec error for free). Slice-spanning
+    FLAT plans quantize all their collectives exactly as before — every
+    byte of theirs rides DCN anyway."""
     if mode is None:
         return sched
     spec = sched.spec
@@ -893,11 +1213,19 @@ def _quantize_schedule(sched: Schedule, mode: Optional[str]) -> Schedule:
 
     p = spec.mesh_size
     item = spec.itemsize
+    hier = sched.strategy == "hierarchical-a2a"
+    # the number of independently encoded wire rows per exchange: the
+    # destination count of the collective's replica groups — the S
+    # slices for the hierarchical DCN hop, the p devices otherwise
+    n_dest = int(sched.topology["n_slices"]) if hier else p
     groups: Dict[str, List[int]] = {}
     for idx, st in enumerate(sched.steps):
-        if st.is_collective:
-            key = st.overlap if st.overlap is not None else f"_solo{idx}"
-            groups.setdefault(key, []).append(idx)
+        if not st.is_collective:
+            continue
+        if hier and st.tier != "dcn":
+            continue  # the ICI pivot ships exact (see docstring)
+        key = st.overlap if st.overlap is not None else f"_solo{idx}"
+        groups.setdefault(key, []).append(idx)
     sent_of: Dict[int, int] = {}
     for key, idxs in groups.items():
         if sum(sched.steps[i].bytes_moved for i in idxs) < QUANT_MIN_WIRE_BYTES:
@@ -908,10 +1236,10 @@ def _quantize_schedule(sched: Schedule, mode: Optional[str]) -> Schedule:
                 # one neighbor block per hop
                 sent_of[i] = _quant.wire_bytes(st.bytes_moved // item, mode)
             else:
-                # crossing payload = (p-1) per-destination blocks, each
-                # encoded independently (the executor's wire rows)
-                blk_elems = st.bytes_moved // (p - 1) // item
-                sent_of[i] = (p - 1) * _quant.wire_bytes(blk_elems, mode)
+                # crossing payload = (n_dest-1) per-destination blocks,
+                # each encoded independently (the executor's wire rows)
+                blk_elems = st.bytes_moved // (n_dest - 1) // item
+                sent_of[i] = (n_dest - 1) * _quant.wire_bytes(blk_elems, mode)
     if not sent_of:
         return sched
 
@@ -927,8 +1255,9 @@ def _quantize_schedule(sched: Schedule, mode: Optional[str]) -> Schedule:
             full_local = raw
             enc_write = sent
         else:
-            full_local = raw * p // (p - 1)  # incl. the resident diagonal block
-            enc_write = sent * p // (p - 1)
+            # incl. the resident diagonal block
+            full_local = raw * n_dest // (n_dest - 1)
+            enc_write = sent * n_dest // (n_dest - 1)
         new_steps.append(
             Step(
                 "quantize",
@@ -952,6 +1281,7 @@ def _quantize_schedule(sched: Schedule, mode: Optional[str]) -> Schedule:
                 chunk=st.chunk,
                 lane_fill=1.0,  # encoded payloads are dense flat byte streams
                 overlap=st.overlap,
+                tier=st.tier,
             )
         )
         new_steps.append(
@@ -981,9 +1311,20 @@ def _quantize_schedule(sched: Schedule, mode: Optional[str]) -> Schedule:
                 rebuilt.append(g)
                 continue
             wire_new = sum(sent_of[i] for i in idxs)
-            rebuilt.append(
-                _overlap_group(g["tag"], g["laps"], wire_new, g["copy_bytes"])
-            )
+            if "ici_bytes" in g:
+                # tiered group: the codec shrinks only the DCN leg (the
+                # ICI pivot ships exact in hierarchical plans; in
+                # slice-spanning flat plans the ICI leg is 0)
+                rebuilt.append(
+                    _tier_group(
+                        g["tag"], g["laps"], g["ici_bytes"], wire_new,
+                        g["copy_bytes"],
+                    )
+                )
+            else:
+                rebuilt.append(
+                    _overlap_group(g["tag"], g["laps"], wire_new, g["copy_bytes"])
+                )
         new_overlap = _overlap_annotation(rebuilt)
 
     sent_total = raw_total - sum(
@@ -1009,13 +1350,16 @@ def _quantize_schedule(sched: Schedule, mode: Optional[str]) -> Schedule:
         notes=notes,
         overlap=new_overlap,
         quant=ann,
+        topology=sched.topology,
     )
 
 
 # --------------------------------------------------------------------- #
 # the planner                                                           #
 # --------------------------------------------------------------------- #
-def _build(spec: RedistSpec, budget: int) -> Schedule:
+def _build(
+    spec: RedistSpec, budget: int, topo: Optional[Tuple[int, int]] = None
+) -> Schedule:
     p = spec.mesh_size
 
     if spec.is_reshape:
@@ -1042,13 +1386,21 @@ def _build(spec: RedistSpec, budget: int) -> Schedule:
                 )
             return Schedule(spec, "local-reshape", steps, budget)
         if spec.dst_split is None:
-            return _gather_reshape_schedule(spec, budget)
+            return _tier_flat(_gather_reshape_schedule(spec, budget), topo)
         candidates = []
         if _pivot_valid(spec):
-            candidates.append(_pivot_schedule(spec, budget))
+            candidates.append(_tier_flat(_pivot_schedule(spec, budget), topo))
             if any(_packed_sides(spec)):
-                candidates.append(_packed_pivot_schedule(spec, budget))
-        candidates.append(_gather_reshape_schedule(spec, budget))
+                candidates.append(
+                    _tier_flat(_packed_pivot_schedule(spec, budget), topo)
+                )
+            if topo is not None:
+                # the hierarchical pivot variants (ISSUE 8): every stage
+                # exchange decomposed across tiers
+                candidates.append(_pivot_schedule(spec, budget, topo=topo))
+                if any(_packed_sides(spec)):
+                    candidates.append(_packed_pivot_schedule(spec, budget, topo=topo))
+        candidates.append(_tier_flat(_gather_reshape_schedule(spec, budget), topo))
         return _select(candidates)
 
     # pure resplit
@@ -1070,12 +1422,15 @@ def _build(spec: RedistSpec, budget: int) -> Schedule:
             budget,
         )
     if spec.dst_split is None:
-        return _gather_reshape_schedule(spec, budget)
-    return _select(_resplit_candidates(spec, budget))
+        return _tier_flat(_gather_reshape_schedule(spec, budget), topo)
+    return _select(_resplit_candidates(spec, budget, topo))
 
 
 def plan(
-    spec: RedistSpec, budget: Optional[int] = None, quant: Optional[str] = None
+    spec: RedistSpec,
+    budget: Optional[int] = None,
+    quant: Optional[str] = None,
+    topology=None,
 ) -> Schedule:
     """Plan ``spec`` under ``budget`` bytes (default: the env knob).
 
@@ -1083,10 +1438,15 @@ def plan(
     full-width exact-bit schedule, ``"int8"``/``"bf16"`` force that
     codec through the admissibility policy, and the default ``None``
     resolves the ``HEAT_TPU_WIRE_QUANT`` gate (:func:`wire_quant_gate`).
-    Plans are cached per (spec, budget, resolved codec) — the codec is
-    part of the canonical serialization and plan_id, so a gate flip can
-    never serve a stale plan. Cache hits/misses and the planned
-    byte/step/peak totals feed the telemetry registry."""
+    ``topology`` pins the two-tier topology the same way (ISSUE 8):
+    ``None`` resolves the ambient ``HEAT_TPU_TOPOLOGY``, ``"flat"``
+    forces one ICI domain (the pre-topology plans, byte-identical), an
+    ``"SxC"`` string / ``(S, C)`` tuple forces a simulated
+    factorization. Plans are cached per (spec, budget, resolved codec,
+    resolved topology) — all four are part of the canonical
+    serialization and plan_id, so a gate flip can never serve a stale
+    plan. Cache hits/misses and the planned byte/step/peak totals feed
+    the telemetry registry."""
     b = budget_bytes() if budget is None else int(budget)
     if quant is None:
         qmode = wire_quant_gate()
@@ -1098,14 +1458,15 @@ def plan(
         if quant not in _MODES:
             raise ValueError(f"plan: unknown wire codec {quant!r}")
         qmode = quant
-    key = (spec, b, qmode or "0")
+    topo = resolve_topology(spec.mesh_size, topology)
+    key = (spec, b, qmode or "0", topo)
     with _plan_lock:
         cached = _plan_cache.get(key)
     if cached is not None:
         if _telemetry._ENABLED:
             _telemetry.inc("redist.plan_cache.hit")
         return cached
-    sched = _quantize_schedule(_build(spec, b), qmode)
+    sched = _quantize_schedule(_build(spec, b, topo), qmode)
     with _plan_lock:
         if len(_plan_cache) >= _PLAN_CACHE_MAX:
             _plan_cache.pop(next(iter(_plan_cache)))
@@ -1130,17 +1491,22 @@ def plan(
             ),
             quant=sched.quant["mode"] if sched.quant else None,
             wire_bytes_saved=sched.wire_bytes_raw - sched.wire_bytes_sent,
+            topology=f"{topo[0]}x{topo[1]}" if topo else None,
+            dcn_bytes=sched.tier_bytes()["dcn"] if topo else 0,
         )
     return sched
 
 
-def explain(arr, axis=None, *, reshape=None, new_split=None) -> Schedule:
+def explain(arr, axis=None, *, reshape=None, new_split=None, topology=None) -> Schedule:
     """The chosen redistribution plan for ``arr`` — without executing it.
 
     ``explain(arr, axis)`` plans the resplit to ``axis``;
     ``explain(arr, reshape=shape, new_split=...)`` plans the
     reshape-with-repartition (``new_split`` defaults the same way
-    ``ht.reshape`` defaults it). Returns the
+    ``ht.reshape`` defaults it). ``topology`` overrides the ambient
+    ``HEAT_TPU_TOPOLOGY`` (``"flat"``, ``"SxC"``, a ``Topology``, or an
+    ``(S, C)`` tuple) — what-if planning for a mesh factorization this
+    process is not running on. Returns the
     :class:`~heat_tpu.redistribution.schedule.Schedule` the executor
     would compile — strategy, steps, per-step peak-memory accounting,
     plan id.
@@ -1183,7 +1549,7 @@ def explain(arr, axis=None, *, reshape=None, new_split=None) -> Schedule:
         spec = RedistSpec.normalize(
             arr.gshape, np.dtype(arr._phys.dtype).name, arr.split, axis, arr.comm.size
         )
-    return plan(spec)
+    return plan(spec, topology=topology)
 
 
 # --------------------------------------------------------------------- #
@@ -1228,5 +1594,16 @@ def golden_specs() -> List[Tuple[str, RedistSpec]]:
         (
             "reshape_lane_1gb_p8",
             S((65536, 4096), "float32", 1, 1, 8, reshape_to=(131072, 2048)),
+        ),
+        # ISSUE 8: the 2x8-acceptance pair — mesh-16 variants of the two
+        # 1 GB rows, covered flat here and tiered by the --topology 2x8
+        # determinism dump + tests/test_topology.py. The reshape uses the
+        # flat-order-preserving 16-divisible view of the 1 GB payload
+        # (1000 % 16 != 0 rules the bench shape's pivot out at p=16;
+        # (16000, 15625) is the same row-major element order).
+        ("resplit_1gb_p16", S((1000, 250000), "float32", 0, 1, 16)),
+        (
+            "reshape_split1_1gb_p16",
+            S((16000, 15625), "float32", 1, 1, 16, reshape_to=(10_000_000, 25)),
         ),
     ]

@@ -44,6 +44,22 @@ collectives launch: the census (and the lap/pipe structure) is
 identical gate-on vs gate-off by construction, while the canonical
 serialization — and therefore the ``plan_id`` and every program cache
 key derived from it — distinguishes the quantized plan.
+
+ISSUE 8 adds the **tier annotations**: at a two-tier topology
+(``HEAT_TPU_TOPOLOGY``, ``core.communication.Topology``) every
+collective step carries a ``tier`` — ``"ici"`` when its replica groups
+stay within one slice, ``"dcn"`` when they span slices — and the
+schedule carries a ``topology`` annotation ({n_slices,
+chips_per_slice, dcn_penalty}; the per-tier byte split is derived from
+the steps via :meth:`Schedule.tier_bytes`, so the codec pass can
+re-scale ``bytes_moved`` without staling the annotation). The cost
+model prices a DCN byte at ``dcn_penalty`` (= ICI/DCN bandwidth ≈ 8)
+ICI bytes, ``describe()`` renders the per-tier byte/time split, and
+both annotations fold into the canonical serialization and
+``plan_id``.
+CRITICALLY, both are *conditional* keys: a flat-topology plan
+serializes without them, byte-identical to the pre-ISSUE-8 plans — the
+``HEAT_TPU_TOPOLOGY`` unset/1xN escape hatch is exact by construction.
 """
 
 from __future__ import annotations
@@ -103,11 +119,17 @@ class Step:
         lap of a software-pipelined chunk group — chunk k's local work
         overlaps chunk k+1's collective inside the group; ``None`` for
         steps the executor issues sequentially.
+    tier : ``"ici"`` / ``"dcn"`` at a two-tier topology (ISSUE 8):
+        which wire a collective step's replica groups ride — ``"ici"``
+        for intra-slice subgroups, ``"dcn"`` when the groups span
+        slices. ``None`` for local steps and every flat-topology plan
+        (the key is then omitted from the serialization, keeping flat
+        plans byte-identical to the pre-topology era).
     """
 
     __slots__ = (
         "kind", "bytes_moved", "bytes_copied", "peak_bytes", "lane_fill",
-        "detail", "chunk", "overlap",
+        "detail", "chunk", "overlap", "tier",
     )
 
     def __init__(
@@ -120,9 +142,12 @@ class Step:
         bytes_copied: int = 0,
         lane_fill: float = 1.0,
         overlap: Optional[str] = None,
+        tier: Optional[str] = None,
     ):
         if kind not in COLLECTIVE_STEP_KINDS and kind not in _LOCAL_STEP_KINDS:
             raise ValueError(f"unknown step kind {kind!r}")
+        if tier not in (None, "ici", "dcn"):
+            raise ValueError(f"unknown tier {tier!r} (expected 'ici'/'dcn'/None)")
         self.kind = kind
         self.bytes_moved = int(bytes_moved)
         self.bytes_copied = int(bytes_copied)
@@ -131,6 +156,7 @@ class Step:
         self.detail = detail
         self.chunk = chunk
         self.overlap = overlap
+        self.tier = tier
 
     @property
     def is_collective(self) -> bool:
@@ -143,7 +169,7 @@ class Step:
         return int((self.bytes_moved + self.bytes_copied) / max(self.lane_fill, 1e-9))
 
     def as_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "kind": self.kind,
             "bytes_moved": self.bytes_moved,
             "bytes_copied": self.bytes_copied,
@@ -153,10 +179,16 @@ class Step:
             "chunk": self.chunk,
             "overlap": self.overlap,
         }
+        # conditional: a flat-topology plan must serialize byte-identically
+        # to the pre-ISSUE-8 era, so untier'd steps carry no key at all
+        if self.tier is not None:
+            d["tier"] = self.tier
+        return d
 
     def __repr__(self) -> str:
         c = f"[{self.chunk}]" if self.chunk is not None else ""
-        return f"Step({self.kind}{c}, moved={self.bytes_moved}, peak={self.peak_bytes})"
+        t = f", tier={self.tier}" if self.tier else ""
+        return f"Step({self.kind}{c}, moved={self.bytes_moved}, peak={self.peak_bytes}{t})"
 
 
 class Schedule:
@@ -192,6 +224,7 @@ class Schedule:
         notes: str = "",
         overlap: Optional[Dict[str, Any]] = None,
         quant: Optional[Dict[str, Any]] = None,
+        topology: Optional[Dict[str, Any]] = None,
     ):
         self.spec = spec
         self.strategy = strategy
@@ -200,6 +233,7 @@ class Schedule:
         self.notes = notes
         self.overlap = overlap
         self.quant = quant
+        self.topology = topology
         self.plan_id = hashlib.sha1(
             self.canonical_json(with_plan_id=False).encode()
         ).hexdigest()[:12]
@@ -287,6 +321,25 @@ class Schedule:
             extra = int(self.overlap["sequential_bytes"]) - group_wire
         return self.effective_bytes + extra
 
+    @property
+    def topo_key(self) -> Optional[Tuple[int, int]]:
+        """``(n_slices, chips_per_slice)`` of a tiered plan, ``None``
+        for flat — the hashable form the executor's program cache keys
+        carry."""
+        if not self.topology:
+            return None
+        return (int(self.topology["n_slices"]), int(self.topology["chips_per_slice"]))
+
+    def tier_bytes(self) -> Dict[str, int]:
+        """Per-tier collective payload split: ``{"ici": B, "dcn": B}``.
+        Flat plans (every pre-topology schedule) report all movement as
+        ``"ici"`` — one ICI domain is tier 0 by definition."""
+        out = {"ici": 0, "dcn": 0}
+        for s in self.steps:
+            if s.is_collective:
+                out[s.tier or "ici"] += s.bytes_moved
+        return out
+
     def collective_counts(self) -> Dict[str, int]:
         """{HLO op name: count} the executed program must launch —
         directly comparable with
@@ -316,6 +369,10 @@ class Schedule:
             "overlap": self.overlap,
             "quant": self.quant,
         }
+        # conditional (ISSUE 8): flat plans serialize without the key so
+        # their bytes — and plan_ids — match the pre-topology era exactly
+        if self.topology is not None:
+            d["topology"] = self.topology
         if with_plan_id:
             d["plan_id"] = self.plan_id
         return d
@@ -343,8 +400,18 @@ class Schedule:
         for k, s in enumerate(self.steps):
             chunk = f"[{s.chunk}]" if s.chunk is not None else ""
             pipe = f"  pipe={s.overlap}" if s.overlap else ""
+            tier = f"  tier={s.tier}" if s.tier else ""
             g = groups.get(s.overlap)
-            if g and s.is_collective:
+            if g and s.is_collective and "ici_bytes" in g:
+                # tiered group (ISSUE 8): a pipelined lap is priced at
+                # max(ici wire, penalty-scaled dcn wire, copy)
+                wi = g["ici_bytes"] // g["laps"]
+                wd = g["dcn_bytes"] * g["dcn_penalty"] // g["laps"]
+                c = g["copy_bytes"] // g["laps"]
+                model = (
+                    f"  model=max(ici {wi}, dcn {wd}, copy {c})={max(wi, wd, c)} B-eq"
+                )
+            elif g and s.is_collective:
                 # per-step modeled time under depth-2 pipelining: this
                 # lap's wire overlaps the previous lap's reassembly copy
                 w = g["wire_bytes"] // g["laps"]
@@ -354,7 +421,7 @@ class Schedule:
                 model = f"  model={s.effective_bytes} B"
             lines.append(
                 f"  [{k:2d}] {s.kind}{chunk}  moved={s.bytes_moved}  "
-                f"copied={s.bytes_copied}  peak={s.peak_bytes}{pipe}{model}"
+                f"copied={s.bytes_copied}  peak={s.peak_bytes}{tier}{pipe}{model}"
                 + (f"  -- {s.detail}" if s.detail else "")
             )
         if self.overlap:
@@ -377,6 +444,15 @@ class Schedule:
             )
         else:
             lines.append("  quant: none (full-width wire)")
+        if self.topology:
+            t = self.topology
+            tb = self.tier_bytes()
+            lines.append(
+                f"  topology: {t['n_slices']}x{t['chips_per_slice']} two-tier  "
+                f"ici={tb['ici']} B  dcn={tb['dcn']} B "
+                f"(dcn priced {t['dcn_penalty']}x — "
+                f"time-eq {tb['ici'] + tb['dcn'] * t['dcn_penalty']} B)"
+            )
         if self.notes:
             lines.append(f"  notes: {self.notes}")
         return "\n".join(lines)
@@ -387,7 +463,12 @@ class Schedule:
         ]
         ov = f", overlap=depth{self.overlap_depth}" if self.overlap else ""
         qt = f", quant={self.quant['mode']}" if self.quant else ""
+        tp = (
+            f", topo={self.topology['n_slices']}x{self.topology['chips_per_slice']}"
+            if self.topology
+            else ""
+        )
         return (
             f"Schedule({self.strategy}, plan={self.plan_id}, {self.spec!r}, "
-            f"steps={kinds}, peak={self.peak_bytes}B/{self.budget_bytes}B{ov}{qt})"
+            f"steps={kinds}, peak={self.peak_bytes}B/{self.budget_bytes}B{ov}{qt}{tp})"
         )

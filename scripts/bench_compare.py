@@ -74,6 +74,10 @@ HIGHER_IS_BETTER = {
     # v5e-64 quantized-gradient DP model's step-time speedup
     # (dp_step_quant row; tests pin >= 1.5x on ICI-bound layers)
     "dp_model_speedup",
+    # two-tier acceptance field (ISSUE 8): hierarchical-vs-flat modeled
+    # speedup of the `*_2x8_dcn` rows (tests pin >= 2x; dp_step_quant_2x8
+    # reuses dp_model_speedup)
+    "tier_model_speedup",
 }
 
 # rows that changed name across rounds: a baseline row under the old
@@ -95,6 +99,9 @@ LOWER_IS_BETTER = {
     # gated redistribution rows (and the dp_step_quant model row) —
     # a ratio drifting back toward 1.0 means the codec disengaged
     "wire_ratio",
+    # ISSUE 8: per-device bytes the tiered plans route over the
+    # expensive tier — growth means movement regressed onto DCN
+    "dcn_bytes",
 }
 
 

@@ -86,6 +86,27 @@ HEAT_TPU_WIRE_QUANT=1 python -m pytest tests/test_quant.py tests/test_redistribu
 
 HEAT_TPU_WIRE_QUANT=0 python -m pytest tests/test_quant.py tests/test_redistribution.py tests/test_overlap.py -q "$@"
 
+# two-tier topology legs (ISSUE 8): the simulated 2x4 factorization of
+# the 8-device mesh forced over the redistribution/overlap/quant suites
+# — tiered plans execute end to end, census == tiered plan, the flat
+# golden pins hold via their explicit topology="flat" anchors (leg 16);
+# the two-tier dryrun pins hierarchical-vs-flat bit-identity, TSQR
+# slice-major census, and the hierarchical DP wire (leg 17); and the
+# auto-on-CPU no-op parity leg proves HEAT_TPU_TOPOLOGY=auto on a
+# single-slice world dumps plans byte-identical to the unset default
+# (leg 18)
+HEAT_TPU_TOPOLOGY=2x4 python -m pytest tests/test_topology.py tests/test_redistribution.py tests/test_overlap.py tests/test_quant.py -q "$@"
+
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu HEAT_TPU_TOPOLOGY=2x4 \
+  python -c "import __graft_entry__ as g; g.dryrun_two_tier(8); print('dryrun_two_tier(8): OK')"
+
+topo_a="$(mktemp)"; topo_b="$(mktemp)"
+python scripts/redist_plans.py > "$topo_a"
+HEAT_TPU_TOPOLOGY=auto python scripts/redist_plans.py > "$topo_b"
+diff "$topo_a" "$topo_b"
+echo "HEAT_TPU_TOPOLOGY=auto on CPU: flat plans byte-identical"
+rm -f "$topo_a" "$topo_b"
+
 python scripts/lint.py heat_tpu/
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
@@ -93,12 +114,20 @@ XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
 
 # golden-plan determinism: redistribution plans key the executor's
 # program cache, so two fresh processes must serialize the golden
-# matrix byte-identically (leg 7)
+# matrix byte-identically (leg 7) — at the flat default AND at the
+# forced 2x4/2x8 two-tier topologies (ISSUE 8: tier annotations fold
+# into plan_ids, so the tiered dumps must be just as deterministic)
 plans_a="$(mktemp)"; plans_b="$(mktemp)"
 python scripts/redist_plans.py > "$plans_a"
 python scripts/redist_plans.py > "$plans_b"
 diff "$plans_a" "$plans_b"
 echo "redist golden plans: deterministic ($(wc -l < "$plans_a") plans)"
+for topo in 2x4 2x8; do
+  python scripts/redist_plans.py --topology "$topo" > "$plans_a"
+  python scripts/redist_plans.py --topology "$topo" > "$plans_b"
+  diff "$plans_a" "$plans_b"
+  echo "redist golden plans @$topo: deterministic ($(wc -l < "$plans_a") plans)"
+done
 rm -f "$plans_a" "$plans_b"
 
 if [ -f BENCH_DETAIL.json ] && ls BENCH_r*.json >/dev/null 2>&1; then

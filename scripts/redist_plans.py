@@ -20,9 +20,17 @@ ISSUE 7: every golden spec is dumped TWICE — the full-width plan
 are covered by the determinism diff and an ambient ``HEAT_TPU_WIRE_QUANT``
 cannot make two CI runs diverge.
 
+ISSUE 8: ``--topology SxC`` dumps the golden matrix planned at a forced
+two-tier topology (suffix ``@SxC``). The ci.sh determinism leg runs the
+dump twice at the DEFAULT (flat — pinned explicitly, so an ambient
+``HEAT_TPU_TOPOLOGY`` cannot make runs diverge) and twice at ``2x8``,
+diffing both pairs: tiered plan_ids differ from flat ones only via the
+tier/topology annotations, and both must be byte-identical run-to-run.
+
 Pure Python: no mesh, no jax device work — safe on any container.
 """
 
+import argparse
 import sys
 
 from pathlib import Path
@@ -31,18 +39,29 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument(
+        "--topology",
+        default=None,
+        help="force a two-tier topology (e.g. 2x8) for every golden plan; "
+        "default: flat (pinned — NOT the ambient HEAT_TPU_TOPOLOGY)",
+    )
+    args = ap.parse_args()
+
     from heat_tpu.redistribution import planner
 
-    # the default budget and codec, pinned explicitly so an ambient
-    # HEAT_TPU_REDIST_BUDGET_MB / HEAT_TPU_WIRE_QUANT cannot make two
-    # CI runs diverge
+    # the default budget / codec / topology, pinned explicitly so an
+    # ambient HEAT_TPU_REDIST_BUDGET_MB / HEAT_TPU_WIRE_QUANT /
+    # HEAT_TPU_TOPOLOGY cannot make two CI runs diverge
     budget = planner.DEFAULT_BUDGET_MB << 20
+    topology = args.topology if args.topology else "flat"
+    suffix = f"@{args.topology}" if args.topology else ""
     for name, spec in planner.golden_specs():
-        sched = planner.plan(spec, budget, quant="0")
-        print(f"{name}\t{sched.canonical_json()}")
+        sched = planner.plan(spec, budget, quant="0", topology=topology)
+        print(f"{name}{suffix}\t{sched.canonical_json()}")
     for name, spec in planner.golden_specs():
-        sched = planner.plan(spec, budget, quant="int8")
-        print(f"{name}.quant\t{sched.canonical_json()}")
+        sched = planner.plan(spec, budget, quant="int8", topology=topology)
+        print(f"{name}.quant{suffix}\t{sched.canonical_json()}")
     return 0
 
 

@@ -86,6 +86,34 @@ def int8_wire_program(x):
     )(phys)
 
 
+def flat_dcn_a2a_program(x):
+    """SL107 (cross-tier collective not decomposed): a hand-rolled FLAT
+    all-to-all whose replica group spans every device — at a two-tier
+    topology its whole payload completes at DCN speed (~8x ICI). The
+    sanctioned form is the planner's ``hierarchical-a2a`` (intra-slice
+    pivot + inter-slice exchange of pre-packed per-slice rows), whose
+    stamped programs downgrade to info; this unstamped flat exchange
+    trips the rule at warn/error when ``check(..., topology="SxC")``
+    (or ``HEAT_TPU_TOPOLOGY``) declares a tiered mesh — and is
+    perfectly clean at a flat topology, which is why SL101 alone never
+    catches it."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from heat_tpu.core._jax_compat import shard_map
+
+    comm = x.comm
+    phys = x._phys
+
+    def body(xl):
+        return lax.all_to_all(xl, comm.axis_name, 0, 0, tiled=True)
+
+    spec = P(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+    return shard_map(
+        body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    )(phys)
+
+
 def ppermute_ring_program(x):
     """SL101: a hand-rolled ppermute relayout loop with NO plan stamp —
     every hop ships the whole local shard around the ring (an all-gather

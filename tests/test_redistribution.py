@@ -35,6 +35,14 @@ P = len(jax.devices())
 # HEAT_TPU_REDIST_BUDGET_MB cannot skew the golden pins
 BUDGET = planner.DEFAULT_BUDGET_MB << 20
 
+# the ambient two-tier topology (ISSUE 8): None on the default flat
+# CPU mesh, (S, C) under the forced HEAT_TPU_TOPOLOGY=2x4 CI leg. The
+# golden STRATEGY pins below are the flat contract and pass
+# topology="flat" explicitly; the census==HLO and executor-equivalence
+# tests run AMBIENT, so the forced leg exercises the tiered programs
+# end to end against their own plans.
+AMBIENT_TOPO = planner.resolve_topology(P)
+
 # name -> (strategy, n_steps, collective census) under the default budget.
 # n_steps pins the CODEC-FREE step structure: under a forced
 # HEAT_TPU_WIRE_QUANT gate the admissible plans additionally carry
@@ -72,6 +80,10 @@ GOLDEN_PINS = {
     # lane-friendly companion (512/256-lane shards): packing gains
     # nothing, the DIRECT pivot stays; 4 overlap laps per side
     "reshape_lane_1gb_p8": ("split0-pivot", 19, {"all-to-all": 8}),
+    # the ISSUE 8 mesh-16 pair: flat pins here, tiered (2x8) pins in
+    # tests/test_topology.py
+    "resplit_1gb_p16": ("all-to-all", 2, {"all-to-all": 1}),
+    "reshape_split1_1gb_p16": ("packed-pivot", 10, {"all-to-all": 3}),
 }
 
 
@@ -84,25 +96,31 @@ def _planner_program(comm, spec, budget, pipelined=False):
     for the direct-placement strategies (noop/local/slice/replicate).
     ``pipelined`` selects the ISSUE-6 software-pipelined issue order of
     the chunk loops (same collectives; tests pin both forms). The wire
-    codec follows the ambient HEAT_TPU_WIRE_QUANT gate through the
-    plan, exactly like execute() — so the forced CI leg compiles the
-    encoded-payload program forms here too."""
+    codec AND topology follow the ambient gates through the plan,
+    exactly like execute() — so the forced CI legs compile the
+    encoded-payload and hierarchical program forms here too."""
     sched = planner.plan(spec, budget)
     strategy = sched.strategy
     wire = sched.quant["mode"] if sched.quant else None
+    topo = sched.topo_key
     if strategy in ("noop", "local", "slice", "replicate"):
         return None
     if strategy in ("all-to-all", "chunked-all-to-all", "ring"):
-        return executor._move_program(comm, spec, budget, pipelined, wire)
-    if strategy == "split0-pivot":
-        return executor._pivot_program(comm, spec, budget, pipelined, wire)
-    if strategy == "packed-pivot":
+        return executor._move_program(comm, spec, budget, pipelined, wire, topo)
+    if strategy == "hierarchical-a2a" and not spec.is_reshape:
+        return executor._move_program(comm, spec, budget, pipelined, wire, topo)
+    if strategy == "split0-pivot" or (
+        strategy == "hierarchical-a2a"
+        and not any(s.kind in ("pack", "unpack") for s in sched.steps)
+    ):
+        return executor._pivot_program(comm, spec, budget, pipelined, wire, topo)
+    if strategy in ("packed-pivot", "hierarchical-a2a"):
         impl_in, impl_out = executor._relayout_impls(spec, sched)
         return executor._packed_pivot_program(
-            comm, spec, budget, impl_in, impl_out, pipelined, wire
+            comm, spec, budget, impl_in, impl_out, pipelined, wire, topo
         )
     if strategy == "gather-reshape":
-        return executor._gather_reshape_program(comm, spec, budget)
+        return executor._gather_reshape_program(comm, spec, budget, topo)
     return executor._local_reshape_program(comm, spec, budget)
 
 
@@ -111,9 +129,12 @@ class TestGoldenPlans(TestCase):
         self.assertEqual({n for n, _ in _golden()}, set(GOLDEN_PINS))
 
     def test_strategy_step_count_and_census_pinned(self):
+        # pinned at topology="flat": the flat contract must hold
+        # verbatim regardless of the ambient HEAT_TPU_TOPOLOGY (the 2x4
+        # leg's tiered strategies are pinned in tests/test_topology.py)
         for name, spec in _golden():
             strategy, n_steps, census = GOLDEN_PINS[name]
-            sched = planner.plan(spec, BUDGET)
+            sched = planner.plan(spec, BUDGET, topology="flat")
             self.assertEqual(sched.strategy, strategy, name)
             # codec steps (forced HEAT_TPU_WIRE_QUANT legs) ride in
             # pairs around collectives without changing the pinned
@@ -149,7 +170,7 @@ class TestGoldenPlans(TestCase):
         configured budget — not the old full all-gather."""
         (spec,) = [s for n, s in _golden() if n == "reshape_split1_1gb_p8"]
         self.assertEqual(spec.logical_bytes, 10**9)
-        sched = planner.plan(spec, planner.budget_bytes())
+        sched = planner.plan(spec, planner.budget_bytes(), topology="flat")
         self.assertEqual(sched.strategy, "packed-pivot")
         for step in sched.steps:
             self.assertLessEqual(step.peak_bytes, planner.budget_bytes())
@@ -161,8 +182,8 @@ class TestGoldenPlans(TestCase):
         default plan already runs 4 overlap-grain laps, so the budget
         must drop past that point before it binds — BUDGET//4 forces 8.)"""
         (spec,) = [s for n, s in _golden() if n == "resplit_chunked_2gb_p8"]
-        base = planner.plan(spec, BUDGET)
-        tight = planner.plan(spec, BUDGET // 4)
+        base = planner.plan(spec, BUDGET, topology="flat")
+        tight = planner.plan(spec, BUDGET // 4, topology="flat")
         self.assertLessEqual(tight.peak_bytes, BUDGET // 4)
         # the tighter plan pipelines more collectives (chunk laps, or the
         # p-1 ppermute hops of the minimal-footprint ring)
