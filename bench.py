@@ -1239,6 +1239,164 @@ def measure_heat_tpu() -> dict:
     return out
 
 
+def _serving_qps_row() -> dict:
+    """serving_qps (ISSUE 9): sustained micro-batched QPS + per-request
+    p95 at a fixed bucket shape — concurrent clients against one
+    dispatcher, measured in-process (the dispatcher worker and the
+    clients are real threads; the accelerator sees only bucket-shaped
+    programs). floor/retry: while the drain finishes under the
+    physical floor (the batches' HBM traffic), re-measure and keep the
+    SLOWEST drain — over-measurement only under-reports QPS."""
+    import threading
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import heat_tpu.serving as srv
+    from heat_tpu.cluster import _kcluster
+
+    d, k, bucket = 64, 16, 256
+    req_rows, n_clients, reqs_per_client = 32, 4, 24
+    total = n_clients * reqs_per_client
+    rng = np.random.default_rng(0)
+    centers = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+    spec = _kcluster.serving_spec("euclidean", centers)
+    prog = spec["build"]()
+    payloads = rng.normal(size=(n_clients, req_rows, d)).astype(np.float32)
+
+    def run_once():
+        ep = srv.Endpoint({bucket: prog}, (d,), np.float32,
+                          extra_args=(centers,), name="bench")
+        disp = srv.Dispatcher(ep, max_queue=total + 8, poll_s=0.001)
+        disp.start()
+        try:
+            disp.call(payloads[0], timeout=120)  # warm: compile outside the clock
+            barrier = threading.Barrier(n_clients + 1)
+
+            client_errors = []
+
+            def client(i):
+                try:
+                    barrier.wait()
+                    futs = [disp.submit(payloads[i]) for _ in range(reqs_per_client)]
+                    for f in futs:
+                        f.result(timeout=120)
+                except Exception as e:  # a dead client = a bogus row, flagged below
+                    client_errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join(300)
+            elapsed = time.perf_counter() - t0
+            ok = not client_errors and not any(t.is_alive() for t in threads)
+            return elapsed, disp.stats(), ok
+        finally:
+            disp.stop()
+
+    # physical floor: every batch reads its bucket slab once
+    n_batches_min = -(-total * req_rows // bucket)
+    floor = n_batches_min * bucket * d * 4 / V5E_HBM_BPS
+    elapsed, stats, ok = run_once()
+    for _ in range(2):
+        if elapsed >= floor:
+            break
+        e2, s2, ok2 = run_once()
+        if e2 > elapsed:
+            elapsed, stats, ok = e2, s2, ok2
+    row = {
+        "qps": round(total / elapsed, 1),
+        "p50_s": round(stats["p50_s"], 6),
+        "p95_s": round(stats["p95_s"], 6),
+        "bucket": bucket,
+        "req_rows": req_rows,
+        "clients": n_clients,
+        "requests": total,
+        "batches": stats["batches"],
+        "padded_frac": round(
+            stats["padded_rows"] / max(stats["rows"] + stats["padded_rows"], 1), 3
+        ),
+        "queue_depth_max": stats["queue_depth_max"],
+        "method": (
+            "in-process dispatcher drain: 4 client threads x 24 requests of "
+            "32 rows, kcluster predict program at bucket 256 (floor/retry, "
+            "slowest drain kept)"
+        ),
+    }
+    # total + 1: the out-of-clock warmup call rides the same counters;
+    # a client that died (timeout/exception) makes elapsed meaningless
+    if (not ok or stats["requests"] != total + 1
+            or stats["rejected"] or stats["shed"]):
+        row["measurement_suspect"] = True
+    return row
+
+
+def _serving_coldstart_row() -> dict:
+    """serving_coldstart (ISSUE 9): AOT-load vs compile, measured the
+    only honest way — two FRESH processes against the same store: the
+    first with an empty cache (trace + XLA compile + export), the
+    second warm (deserialize). Interpreter/jax import time is excluded
+    on both sides (the child clocks only program acquisition).
+    floor/retry: the warm child re-runs with the SLOWEST load kept —
+    under-reports the speedup, the safe direction. Target >= 10x
+    (acceptance pinned on TPU rounds, where XLA compile dominates)."""
+    import subprocess
+    import tempfile
+
+    code = (
+        "import json,os,time;"
+        "import heat_tpu as ht;"
+        "import jax,jax.numpy as jnp;"
+        # backend init + dispatch machinery OUT of the clock on both
+        # sides: the row measures program acquisition, not jax startup
+        "ht.zeros(1);"
+        "jax.block_until_ready(jax.jit(lambda a:a+1)(jnp.ones(4)));"
+        "t0=time.perf_counter();"
+        "r=ht.serving.warmup(['kcluster_predict']);"
+        "dt=time.perf_counter()-t0;"
+        "s=sorted(set(x for v in r.values() for x in v['variants'].values()));"
+        "print(json.dumps({'acquire_s':dt,'statuses':s}))"
+    )
+    root = os.path.dirname(os.path.abspath(__file__))
+
+    with tempfile.TemporaryDirectory() as store:
+        env = dict(os.environ, HEAT_TPU_SERVING_AOT="1", HEAT_TPU_SERVING_CACHE=store)
+
+        def child():
+            p = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True, text=True,
+                env=env, cwd=root, timeout=900,
+            )
+            return json.loads(p.stdout.strip().splitlines()[-1])
+
+        cold = child()  # empty store: trace + compile + export
+        warm = child()  # warm store: deserialize
+        for _ in range(2):
+            w2 = child()
+            if w2["acquire_s"] > warm["acquire_s"]:
+                warm = w2
+    row = {
+        "compile_s": round(cold["acquire_s"], 4),
+        "load_s": round(warm["acquire_s"], 4),
+        "coldstart_speedup": round(cold["acquire_s"] / max(warm["acquire_s"], 1e-9), 2),
+        "cold_statuses": cold["statuses"],
+        "warm_statuses": warm["statuses"],
+        "method": (
+            "fresh-process warmup(kcluster_predict): empty store "
+            "(trace+compile+export) vs warm store (jax.export deserialize; "
+            "+ the XLA executable cache where the backend supports it); "
+            "slowest warm load kept"
+        ),
+    }
+    if cold["statuses"] != ["store"] or warm["statuses"] != ["hit"]:
+        row["measurement_suspect"] = True
+    return row
+
+
 def main() -> None:
     if "--measure-baseline" in sys.argv:
         base = measure_baseline()
@@ -1453,6 +1611,21 @@ def main() -> None:
         }
     except Exception:  # pragma: no cover — the model must never take bench down
         pass
+
+    # serving rows (ISSUE 9): measured, not modeled — the dispatcher
+    # drain (QPS + p95 at a fixed bucket) and the fresh-process
+    # AOT-load-vs-compile ratio. Guarded: serving must never take the
+    # bench down with it.
+    try:
+        detail["serving_qps"] = _serving_qps_row()
+        _progress("serving_qps", 1.0 / max(detail["serving_qps"]["qps"], 1e-9))
+    except Exception as e:  # pragma: no cover — diagnostics only
+        print(f"[bench] serving_qps skipped: {e}", file=sys.stderr, flush=True)
+    try:
+        detail["serving_coldstart"] = _serving_coldstart_row()
+        _progress("serving_coldstart", detail["serving_coldstart"]["load_s"])
+    except Exception as e:  # pragma: no cover — diagnostics only
+        print(f"[bench] serving_coldstart skipped: {e}", file=sys.stderr, flush=True)
 
     # chip rows
     mfu("matmul_bf16_8k", 2 * MM_8K**3)
@@ -1687,6 +1860,17 @@ def main() -> None:
             "dp_step_quant_2x8": (
                 pick("dp_step_quant_2x8", "dp_model_speedup", "dcn_bytes")
                 if "dp_step_quant_2x8" in detail else {}
+            ),
+            # ISSUE 9 serving rows: sustained micro-batched QPS + p95 and
+            # the fresh-process AOT-load-vs-compile ratio (target >= 10x
+            # on TPU rounds) — gated by scripts/bench_compare.py
+            "serving_qps": (
+                pick("serving_qps", "qps", "p95_s", "measurement_suspect")
+                if "serving_qps" in detail else {}
+            ),
+            "serving_coldstart": (
+                pick("serving_coldstart", "coldstart_speedup", "measurement_suspect")
+                if "serving_coldstart" in detail else {}
             ),
             "op_chain": pick("op_chain", "overhead_vs_raw_jnp", "overhead_vs_fused_jnp"),
             "ht_jit_chain": pick("ht_jit_chain", "overhead_vs_fused_jnp") if "ht_jit_chain" in detail else {},

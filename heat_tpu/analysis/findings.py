@@ -37,7 +37,12 @@ RULES: Dict[str, str] = {
              "both copies live in HBM",
     "SL106": "host-sync: the checked program reads device values on the host "
              "(jax.device_get / .item() / .numpy() / float(...) on a device "
-             "value) — a round-trip that serializes the dispatch pipeline",
+             "value) — a round-trip that serializes the dispatch pipeline. "
+             "The serving budget (ISSUE 9) is the strictest instance: a "
+             "request handler's dispatch→result path must contain ZERO "
+             "undeclared syncs — one blocking read stalls every queued "
+             "request behind it (the dispatcher's own completion fence is "
+             "block_until_ready: synchronizes, never transfers)",
     "SL107": "cross-tier-collective: at a two-tier topology, a flat "
              "collective whose replica groups span slices ships its whole "
              "payload at DCN speed — decompose it hierarchically (intra-slice "

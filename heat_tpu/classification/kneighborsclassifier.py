@@ -23,6 +23,49 @@ from ..spatial import distance
 
 __all__ = ["KNeighborsClassifier"]
 
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _knn_predict_program(n_neighbors: int):
+    """The fused KNN vote ``(xq, xt, y_onehot, classes) -> labels`` as
+    ONE program: pairwise distances (the same direct formula as the
+    default ``spatial.distance.cdist`` path), top-k, one-hot vote,
+    winner lookup. Shared by eager ``predict`` and the serving
+    endpoints (ISSUE 9) so served results are bit-identical to eager
+    ones by construction."""
+
+    def run(xq, xt, y_onehot, classes):
+        diff = xq[:, None, :] - xt[None, :, :]
+        dist = jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+        _, idx = jax.lax.top_k(-dist, n_neighbors)  # (n_query, k)
+        votes = jnp.take(y_onehot, idx, axis=0)  # (n_query, k, n_classes)
+        counts = jnp.sum(votes, axis=1)
+        winners = jnp.argmax(counts, axis=1)
+        return jnp.take(classes, winners)
+
+    return jax.jit(run)
+
+
+def serving_spec(n_neighbors: int, xt: jax.Array, y_onehot: jax.Array,
+                 classes: jax.Array, comm=None) -> dict:
+    """The serving-endpoint description of a KNN predict program
+    (consumed by ``ht.serving.estimator_endpoint`` and the warmup CLI's
+    declared set — both must derive identical AOT cache keys)."""
+    d = int(xt.shape[1])
+    return {
+        "build": lambda: _knn_predict_program(int(n_neighbors)),
+        "args": (xt, y_onehot, classes),
+        "key": (
+            "knn-predict", int(n_neighbors), int(xt.shape[0]), d,
+            int(y_onehot.shape[1]), str(np.dtype(xt.dtype)),
+        ),
+        "feature_shape": (d,),
+        "dtype": np.dtype(xt.dtype),
+        "comm": comm,
+        "name": "knn-predict",
+    }
+
 
 class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
     """Classification by majority vote of the k nearest neighbors
@@ -80,19 +123,47 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
         self.x = x
         return self
 
+    def _compute_dtype(self, query_dtype=None):
+        """The fused program's compute dtype: EXACTLY the promotion
+        ``spatial.distance._prepare`` applies on the composite path —
+        float32 unless the promotion lands on float64 (so f16/bf16
+        operands compute in f32 there and here alike)."""
+        promoted = (
+            self.x.dtype if types.heat_type_is_inexact(self.x.dtype) else types.float32
+        )
+        if query_dtype is not None and types.heat_type_is_inexact(query_dtype):
+            promoted = types.promote_types(promoted, query_dtype)
+        if promoted is not types.float64:
+            promoted = types.float32
+        return promoted
+
+    def _serving_inputs(self, dtype=None):
+        """(xt, y_onehot, classes) in the fused program's compute dtype."""
+        jt = (dtype or self._compute_dtype()).jax_type()
+        return self.x.larray.astype(jt), self.y.larray, self._classes
+
     def predict(self, x: DNDarray) -> DNDarray:
         """Majority vote over the k nearest training points (reference:
-        kneighborsclassifier.py predict)."""
+        kneighborsclassifier.py predict). The default-metric path runs
+        as ONE fused program (``_knn_predict_program``, shared with the
+        serving endpoints); a custom ``effective_metric_`` keeps the
+        composite path."""
         sanitize_in(x)
         if self.x is None:
             raise RuntimeError("fit needs to be called before predict")
-        dist = self.effective_metric_(x, self.x)
-        neg = -dist.larray
-        _, idx = jax.lax.top_k(neg, self.n_neighbors)  # (n_query, k)
-        votes = jnp.take(self.y.larray, idx, axis=0)  # (n_query, k, n_classes)
-        counts = jnp.sum(votes, axis=1)
-        winners = jnp.argmax(counts, axis=1)
-        labels = jnp.take(self._classes, winners)
+        if self.effective_metric_ is distance.cdist:
+            dtype = self._compute_dtype(x.dtype)
+            xt, y_onehot, classes = self._serving_inputs(dtype)
+            xq = x.larray.astype(dtype.jax_type())
+            labels = _knn_predict_program(self.n_neighbors)(xq, xt, y_onehot, classes)
+        else:
+            dist = self.effective_metric_(x, self.x)
+            neg = -dist.larray
+            _, idx = jax.lax.top_k(neg, self.n_neighbors)  # (n_query, k)
+            votes = jnp.take(self.y.larray, idx, axis=0)  # (n_query, k, n_classes)
+            counts = jnp.sum(votes, axis=1)
+            winners = jnp.argmax(counts, axis=1)
+            labels = jnp.take(self._classes, winners)
         gshape = (x.shape[0],)
         split = 0 if x.split is not None else None
         if split is not None:
@@ -100,3 +171,19 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
         return DNDarray(
             labels, gshape, types.canonical_heat_type(labels.dtype), split, x.device, x.comm
         )
+
+    def serving_program(self) -> dict:
+        """The endpoint description ``ht.serving.estimator_endpoint``
+        consumes: the fitted KNN vote program, its replicated model
+        state (training set, one-hot labels, classes), and the
+        persistent AOT cache key parts. Custom metrics have no fused
+        program and cannot be served through an endpoint."""
+        if self.x is None:
+            raise RuntimeError("fit needs to be called before serving")
+        if self.effective_metric_ is not distance.cdist:
+            raise ValueError(
+                "serving_program supports the default euclidean metric only "
+                "(a custom effective_metric_ has no fused serving program)"
+            )
+        xt, y_onehot, classes = self._serving_inputs()
+        return serving_spec(self.n_neighbors, xt, y_onehot, classes, comm=self.x.comm)

@@ -106,6 +106,47 @@ def _fused_fit_program(step, k: int, shape, jdtype: str, tol: float, max_iter: i
 
 
 @functools.lru_cache(maxsize=64)
+def _predict_program(metric: str, eval_fv: bool):
+    """The fused label-assignment program ``(arr, centers) -> labels[,
+    functional value]`` — ONE dispatch for the whole predict path
+    (distances on the MXU, argmin, optional functional value), where
+    the eager composite paid one per op. Shared by eager ``predict``
+    and the serving endpoints (ISSUE 9), so a served request is
+    bit-identical to an eager one by construction; shapes retrace under
+    the same cached program."""
+
+    def run(arr, centers):
+        d = _KCluster._pairwise(arr, centers, metric)
+        labels = jnp.argmin(d, axis=1).astype(types.index_jax_type())
+        if not eval_fv:
+            return labels
+        if metric == "manhattan":
+            fun = jnp.sum(jnp.min(d, axis=1))
+        else:
+            fun = jnp.sum(jnp.min(d, axis=1) ** 2)
+        return labels, fun
+
+    return jax.jit(run)
+
+
+def serving_spec(metric: str, centers: jax.Array, comm=None) -> dict:
+    """The serving-endpoint description of a k-cluster predict program
+    (consumed by ``ht.serving.estimator_endpoint`` and the warmup CLI's
+    declared set — both must derive identical AOT cache keys, which is
+    why the key lives here, next to the program)."""
+    k, d = int(centers.shape[0]), int(centers.shape[1])
+    return {
+        "build": lambda: _predict_program(metric, False),
+        "args": (centers,),
+        "key": ("kcluster-predict", metric, k, d, str(np.dtype(centers.dtype))),
+        "feature_shape": (d,),
+        "dtype": np.dtype(centers.dtype),
+        "comm": comm,
+        "name": "kcluster-predict",
+    }
+
+
+@functools.lru_cache(maxsize=64)
 def _kmeanspp_program(k: int, shape, jdtype: str):
     """Compiled greedy k-means++ seeding: (arr, key) -> (k, d) centers.
     A ``fori_loop`` over the k steps keeps the traced program size
@@ -257,14 +298,12 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         if types.heat_type_is_exact(x.dtype):
             arr = arr.astype(jnp.float32)
         c = self._cluster_centers.larray
-        d = self._pairwise(arr, c, self._assignment_metric)
-        labels = jnp.argmin(d, axis=1).astype(types.index_jax_type())
+        prog = _predict_program(self._assignment_metric, eval_functional_value)
         if eval_functional_value:
-            if self._assignment_metric == "manhattan":
-                # L1 functional value (lazy device scalar, read by inertia_)
-                self._inertia = jnp.sum(jnp.min(d, axis=1))
-            else:
-                self._inertia = jnp.sum(jnp.min(d, axis=1) ** 2)
+            # L1/L2 functional value (lazy device scalar, read by inertia_)
+            labels, self._inertia = prog(arr, c)
+        else:
+            labels = prog(arr, c)
         gshape = (x.shape[0],)
         split = 0 if x.split is not None else None
         if split is not None:
@@ -351,8 +390,21 @@ class _KCluster(BaseEstimator, ClusteringMixin):
 
     def predict(self, x: DNDarray) -> DNDarray:
         """Labels of the closest cluster center for new data (reference:
-        _kcluster.py predict)."""
+        _kcluster.py predict). One fused program dispatch (see
+        ``_predict_program``)."""
         sanitize_in(x)
         if self._cluster_centers is None:
             raise RuntimeError("fit needs to be called before predict")
         return self._assign_to_cluster(x)
+
+    def serving_program(self) -> dict:
+        """The endpoint description ``ht.serving.estimator_endpoint``
+        consumes: the fitted predict program, its replicated model state
+        (the centers), and the persistent AOT cache key parts."""
+        if self._cluster_centers is None:
+            raise RuntimeError("fit needs to be called before serving")
+        return serving_spec(
+            self._assignment_metric,
+            self._cluster_centers.larray,
+            comm=self._cluster_centers.comm,
+        )

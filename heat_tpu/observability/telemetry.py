@@ -28,8 +28,18 @@ Design constraints, in order:
    to hit inside a ``jax.jit``/``ht.jit`` trace (they then fire once per
    compile, not per execution; events carry a ``traced`` field where the
    distinction matters).
-3. **Thread-safe.** One lock around registry mutation; the reservoir is
-   bounded so memory stays O(#metrics).
+3. **Thread-safe AND contention-free under concurrent recorders.** The
+   registry is SHARDED per recording thread (ISSUE 9: the serving
+   dispatcher records request latencies from its worker while client
+   threads bump submit counters): ``inc``/``observe`` touch only the
+   calling thread's shard under that shard's own lock — uncontended in
+   steady state, so recorders never serialize on one global lock — and
+   readers (``snapshot``/``timer_table``) merge the shards. Counter and
+   call totals are exact under any interleaving; the p50/p95 sample
+   reservoir is bounded PER SHARD (``_SAMPLE_CAP`` each), and dead
+   threads' shards fold into one retired accumulator when new threads
+   register, so memory stays O(#metrics × #LIVE-recording-threads)
+   even under request-handler thread churn.
 
 Energy note (perun-parity deviation): this platform exposes no
 in-container energy counter, so the registry records time/bytes/counts
@@ -45,6 +55,7 @@ import json
 import os
 import threading
 import time
+import weakref
 
 from typing import Any, Dict, Iterator, Optional
 
@@ -79,24 +90,95 @@ def _percentile(sorted_samples, q: float) -> float:
     return sorted_samples[idx]
 
 
-class Registry:
-    """Counter + timer store. The module-level singleton backs the public
-    API; ``heat_tpu.utils.monitor`` holds its own always-on instance (the
-    decorator is explicit opt-in, independent of the global switch)."""
+class _Shard:
+    """One recording thread's private accumulator. Only the owning thread
+    mutates it (under ``lock``, uncontended unless a reader is merging),
+    so concurrent recorders never touch each other's state. ``owner`` is
+    a weakref to the recording thread: when the thread dies the registry
+    folds the shard into its retired accumulator (exact totals survive,
+    memory stays O(live threads), not threads-ever)."""
+
+    __slots__ = ("lock", "counters", "timers", "owner")
 
     def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.counters: Dict[str, int] = {}
+        self.timers: Dict[str, dict] = {}
+        self.owner = weakref.ref(threading.current_thread())
+
+
+class Registry:
+    """Counter + timer store, sharded per recording thread. The
+    module-level singleton backs the public API; ``heat_tpu.utils.monitor``
+    holds its own always-on instance (the decorator is explicit opt-in,
+    independent of the global switch)."""
+
+    def __init__(self) -> None:
+        # guards the shard LIST only; per-shard data is guarded by the
+        # shard's own lock (the hot path never takes this one after its
+        # thread's first record). `_retired` absorbs the shards of dead
+        # threads so totals stay exact while memory stays bounded by the
+        # LIVE thread count under churn.
         self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {}
-        self._timers: Dict[str, dict] = {}
+        self._shards: list = []
+        self._retired = _Shard()
+        self._tls = threading.local()
+
+    def _shard(self) -> _Shard:
+        sh = getattr(self._tls, "shard", None)
+        if sh is None:
+            sh = _Shard()
+            self._tls.shard = sh
+            with self._lock:
+                self._prune_locked()
+                self._shards.append(sh)
+        return sh
+
+    def _prune_locked(self) -> None:
+        """Fold shards whose recording thread has exited into the
+        retired accumulator (called under ``self._lock`` whenever a new
+        thread registers — the only moment the shard list grows)."""
+        live = []
+        for sh in self._shards:
+            owner = sh.owner()
+            if owner is not None and owner.is_alive():
+                live.append(sh)
+            else:
+                self._fold_retired(sh)
+        self._shards = live
+
+    def _fold_retired(self, sh: _Shard) -> None:
+        with sh.lock:
+            counters, timers = sh.counters, sh.timers
+            sh.counters, sh.timers = {}, {}
+        with self._retired.lock:
+            for name, value in counters.items():
+                self._retired.counters[name] = self._retired.counters.get(name, 0) + value
+            for name, ent in timers.items():
+                agg = self._retired.timers.get(name)
+                if agg is None:
+                    self._retired.timers[name] = ent
+                else:
+                    agg["calls"] += ent["calls"]
+                    agg["total_s"] += ent["total_s"]
+                    agg["min_s"] = min(agg["min_s"], ent["min_s"])
+                    agg["max_s"] = max(agg["max_s"], ent["max_s"])
+                    agg["samples"].extend(ent["samples"])  # maxlen caps it
+
+    def _all_shards(self) -> list:
+        with self._lock:
+            return list(self._shards) + [self._retired]
 
     def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + int(n)
+        sh = self._shard()
+        with sh.lock:
+            sh.counters[name] = sh.counters.get(name, 0) + int(n)
 
     def observe(self, name: str, seconds: float) -> None:
         seconds = float(seconds)
-        with self._lock:
-            ent = self._timers.get(name)
+        sh = self._shard()
+        with sh.lock:
+            ent = sh.timers.get(name)
             if ent is None:
                 ent = {
                     "calls": 0,
@@ -105,7 +187,7 @@ class Registry:
                     "max_s": 0.0,
                     "samples": collections.deque(maxlen=_SAMPLE_CAP),
                 }
-                self._timers[name] = ent
+                sh.timers[name] = ent
             ent["calls"] += 1
             ent["total_s"] += seconds
             ent["min_s"] = min(ent["min_s"], seconds)
@@ -113,27 +195,53 @@ class Registry:
             ent["samples"].append(seconds)
 
     def clear(self) -> None:
-        with self._lock:
-            self._counters.clear()
-            self._timers.clear()
+        for sh in self._all_shards():
+            with sh.lock:
+                sh.counters.clear()
+                sh.timers.clear()
 
     def counters(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self._counters)
+        merged: Dict[str, int] = {}
+        for sh in self._all_shards():
+            with sh.lock:
+                items = list(sh.counters.items())
+            for name, value in items:
+                merged[name] = merged.get(name, 0) + value
+        return merged
 
     def timer_table(self) -> Dict[str, Dict[str, float]]:
-        """{name: {calls, total_s, best_s, mean_s, max_s, p50_s, p95_s}}."""
-        with self._lock:
-            items = [(k, dict(v), sorted(v["samples"])) for k, v in self._timers.items()]
+        """{name: {calls, total_s, best_s, mean_s, max_s, p50_s, p95_s}}.
+
+        Merged across thread shards: calls/totals are exact sums,
+        min/max exact aggregates, and p50/p95 come from the union of the
+        per-shard sample reservoirs (each bounded by ``_SAMPLE_CAP``)."""
+        merged: Dict[str, dict] = {}
+        for sh in self._all_shards():
+            with sh.lock:
+                items = [(k, dict(v), list(v["samples"])) for k, v in sh.timers.items()]
+            for name, ent, samples in items:
+                agg = merged.get(name)
+                if agg is None:
+                    agg = {
+                        "calls": 0, "total_s": 0.0,
+                        "min_s": float("inf"), "max_s": 0.0, "samples": [],
+                    }
+                    merged[name] = agg
+                agg["calls"] += ent["calls"]
+                agg["total_s"] += ent["total_s"]
+                agg["min_s"] = min(agg["min_s"], ent["min_s"])
+                agg["max_s"] = max(agg["max_s"], ent["max_s"])
+                agg["samples"].extend(samples)
         table = {}
-        for name, ent, samples in items:
-            calls = ent["calls"]
+        for name, agg in merged.items():
+            calls = agg["calls"]
+            samples = sorted(agg["samples"])
             table[name] = {
                 "calls": calls,
-                "total_s": ent["total_s"],
-                "best_s": ent["min_s"] if calls else 0.0,
-                "mean_s": ent["total_s"] / calls if calls else 0.0,
-                "max_s": ent["max_s"],
+                "total_s": agg["total_s"],
+                "best_s": agg["min_s"] if calls else 0.0,
+                "mean_s": agg["total_s"] / calls if calls else 0.0,
+                "max_s": agg["max_s"],
                 "p50_s": _percentile(samples, 0.50),
                 "p95_s": _percentile(samples, 0.95),
             }

@@ -1,0 +1,191 @@
+"""heat_tpu.serving — production serving runtime (ISSUE 9).
+
+The north star is heavy traffic from millions of users, but the library
+ entry points (``predict``/``transform``/``ht.jit`` programs) were
+built for one caller and a warm process. This package adds the three
+pieces a request path needs, on top of the jit/donation/telemetry
+substrate of PRs 1–8:
+
+- :mod:`~heat_tpu.serving.aot_cache` — persistent AOT program cache
+  (``jax.export`` artifacts keyed by the existing (comm, spec, impl,
+  donation, env-gate) signatures + version stamps): cold start is
+  load-not-compile, with corruption/version mismatch falling back to
+  recompile. Gates: ``HEAT_TPU_SERVING_AOT=0/1/auto``,
+  ``HEAT_TPU_SERVING_CACHE=<dir>``.
+- :mod:`~heat_tpu.serving.dispatcher` — async micro-batching: bounded
+  queue, pad-to-bucket coalescing into the fixed batch shapes the
+  programs (and the AOT store) already know, donation-aware depth-2
+  double buffering, per-request p50/p95 + queue-depth telemetry.
+- :mod:`~heat_tpu.serving.admission` — explicit backpressure: bounded
+  depth and deadline shedding with the typed :class:`ServingOverloaded`
+  rejection.
+
+Quick start::
+
+    import heat_tpu as ht
+    ht.serving.configure(cache_dir="/var/cache/heat_tpu")   # or env gates
+    model = ht.cluster.KMeans(n_clusters=8).fit(x)
+    ep = ht.serving.estimator_endpoint(model, buckets=(32, 128))
+    with ht.serving.Dispatcher(ep, max_queue=256) as d:
+        labels = d.call(batch)      # micro-batched with concurrent callers
+
+``scripts/warmup.py`` pre-compiles and exports the declared program set
+(:data:`WARMUP_PROGRAMS`) so a fleet rollout ships a hot cache.
+"""
+
+from __future__ import annotations
+
+import time
+
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .admission import AdmissionControl, ServingOverloaded
+from .aot_cache import (
+    AOTStore,
+    active_store,
+    cache_dir,
+    configure,
+    enabled,
+    ensure_program,
+)
+from .dispatcher import Dispatcher, Endpoint, estimator_endpoint, program_endpoint
+
+__all__ = [
+    "AOTStore",
+    "AdmissionControl",
+    "Dispatcher",
+    "Endpoint",
+    "ServingOverloaded",
+    "WARMUP_PROGRAMS",
+    "active_store",
+    "cache_dir",
+    "configure",
+    "enabled",
+    "ensure_program",
+    "estimator_endpoint",
+    "program_endpoint",
+    "warmup",
+]
+
+
+# ---------------------------------------------------------------------- #
+# declared warmup set                                                    #
+# ---------------------------------------------------------------------- #
+# The canonical serving programs a fleet pre-exports before rollout:
+# estimator predict programs at their bucket shapes plus a representative
+# ht.jit pipeline. Each entry is a callable returning {variant: status}
+# with ensure_program-style statuses ("hit" on a warm store, "store" on
+# first export, "off"/"bypass" otherwise).
+
+
+def _warm_kcluster() -> Dict[str, str]:
+    from ..cluster import _kcluster
+
+    k, d = 8, 16
+    centers = jnp.linspace(0.0, 1.0, k * d, dtype=jnp.float32).reshape(k, d)
+    spec = _kcluster.serving_spec("euclidean", centers)
+    out = {}
+    for bucket in (16, 64):
+        import jax as _jax
+
+        sds = _jax.ShapeDtypeStruct((bucket, d), np.float32)
+        _call, status = ensure_program(
+            tuple(spec["key"]) + (("bucket", bucket),), spec["build"], (sds, *spec["args"])
+        )
+        out[f"b{bucket}"] = status
+    return out
+
+
+def _warm_knn() -> Dict[str, str]:
+    from ..classification import kneighborsclassifier as _knn
+
+    n_train, d, n_classes = 32, 8, 3
+    xt = jnp.linspace(0.0, 1.0, n_train * d, dtype=jnp.float32).reshape(n_train, d)
+    onehot = jnp.eye(n_classes, dtype=jnp.float32)[jnp.arange(n_train) % n_classes]
+    classes = jnp.arange(n_classes, dtype=jnp.int32)
+    spec = _knn.serving_spec(5, xt, onehot, classes)
+    out = {}
+    for bucket in (16,):
+        import jax as _jax
+
+        sds = _jax.ShapeDtypeStruct((bucket, d), np.float32)
+        _call, status = ensure_program(
+            tuple(spec["key"]) + (("bucket", bucket),), spec["build"], (sds, *spec["args"])
+        )
+        out[f"b{bucket}"] = status
+    return out
+
+
+def _gram_norms_pipeline(x):
+    """The declared ht.jit warmup program: a fused matmul+reduction
+    chain over a split array — representative of the linalg entry
+    points a serving pipeline composes."""
+    import heat_tpu as ht
+
+    g = ht.matmul(x, ht.transpose(x))
+    return ht.sqrt(ht.sum(g * g, axis=1))
+
+
+def _warm_htjit() -> Dict[str, str]:
+    import heat_tpu as ht
+
+    store = active_store()
+    before = dict(store.stats) if store is not None else {}
+    x = ht.ones((64, 16), split=0, dtype=ht.float32)
+    jitted = ht.jit(_gram_norms_pipeline)
+    jitted(x)
+    if store is None:
+        return {"pipeline": "off"}
+    # order matters for the --expect-hits reload proof: an envelope-level
+    # hit whose artifact then failed to deserialize ALSO bumps bypass and
+    # recompiles (store) — that run must not report "hit"
+    if store.stats.get("store", 0) > before.get("store", 0):
+        return {"pipeline": "store"}
+    if store.stats.get("bypass", 0) > before.get("bypass", 0):
+        return {"pipeline": "bypass"}
+    if store.stats.get("hit", 0) > before.get("hit", 0):
+        return {"pipeline": "hit"}
+    return {"pipeline": "bypass"}
+
+
+WARMUP_PROGRAMS = {
+    "kcluster_predict": _warm_kcluster,
+    "knn_predict": _warm_knn,
+    "htjit_gram_norms": _warm_htjit,
+}
+
+
+def warmup(names: Optional[list] = None) -> Dict[str, dict]:
+    """Pre-compile and export the declared program set (``names`` =
+    subset of :data:`WARMUP_PROGRAMS`, default all). Returns
+    ``{name: {"variants": {variant: status}, "seconds": t}}`` — on a
+    warm store every status is ``"hit"`` and nothing was traced."""
+    import heat_tpu as ht
+
+    if names:
+        unknown = sorted(set(names) - set(WARMUP_PROGRAMS))
+        if unknown:
+            raise ValueError(
+                f"unknown warmup programs {unknown} — declared set: "
+                f"{sorted(WARMUP_PROGRAMS)}"
+            )
+    # resolve the platform dtype policy (x64/complex, core/devices)
+    # BEFORE any persistent key is derived: the x64 flag is part of
+    # every key, and it must match what a serving process (which builds
+    # arrays before programs) will see
+    ht.zeros(1)
+    results: Dict[str, dict] = {}
+    for name, thunk in WARMUP_PROGRAMS.items():
+        if names and name not in names:
+            continue
+        t0 = time.perf_counter()
+        variants = thunk()
+        results[name] = {
+            "variants": variants,
+            "seconds": round(time.perf_counter() - t0, 4),
+        }
+    return results

@@ -1,0 +1,102 @@
+"""Admission control — explicit backpressure for the serving dispatcher.
+
+A production endpoint under heavy traffic has exactly three honest
+options when work arrives faster than the accelerator drains it: queue
+it (bounded — an unbounded queue converts overload into latency and
+then into OOM), shed it (deadline-aware — a result delivered after the
+caller's deadline is wasted accelerator time), or reject it at the door
+(typed, observable — so the load balancer can back off). This module is
+that policy, factored out of the dispatcher so tests and operators can
+reason about it in one place:
+
+- :class:`ServingOverloaded` — the typed rejection every shed/reject
+  path raises, carrying the reason and the queue state that triggered
+  it (callers pattern-match on the class, dashboards on the fields).
+- :class:`AdmissionControl` — bounded queue depth at submit time plus
+  deadline-aware shedding at dequeue time.
+
+Telemetry: the dispatcher records ``serving.admission.rejected`` /
+``serving.admission.shed`` counters for every decision made here.
+"""
+
+from __future__ import annotations
+
+import time
+
+from typing import Optional
+
+__all__ = ["AdmissionControl", "ServingOverloaded"]
+
+
+class ServingOverloaded(RuntimeError):
+    """Typed rejection: the serving runtime refused or shed a request.
+
+    Attributes
+    ----------
+    reason : ``"queue-full"`` (rejected at submit: the bounded queue is
+        at depth limit), ``"deadline"`` (shed at dequeue: the request's
+        deadline passed while it waited), or ``"shutdown"`` (the
+        dispatcher stopped before serving the queued request — retry
+        against a live replica, do NOT back off as if overloaded).
+    queue_depth : observed queue depth at decision time.
+    limit : the configured bound that was hit (queue capacity, or the
+        deadline in seconds for shed requests; ``None`` for shutdown).
+    """
+
+    def __init__(self, reason: str, queue_depth: Optional[int] = None,
+                 limit: Optional[float] = None):
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.limit = limit
+        detail = f"serving overloaded ({reason})"
+        if queue_depth is not None:
+            detail += f": queue depth {queue_depth}"
+        if limit is not None:
+            detail += f" >= limit {limit}"
+        super().__init__(detail)
+
+
+class AdmissionControl:
+    """Bounded-queue + deadline admission policy.
+
+    Parameters
+    ----------
+    max_queue : maximum number of requests allowed to wait (the
+        dispatcher sizes its queue with this; submit past it raises
+        :class:`ServingOverloaded` immediately instead of blocking the
+        client thread behind an unbounded backlog).
+    default_deadline_s : deadline applied to requests that do not carry
+        their own (``None`` = no deadline: never shed).
+    """
+
+    def __init__(self, max_queue: int = 64,
+                 default_deadline_s: Optional[float] = None):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = int(max_queue)
+        self.default_deadline_s = default_deadline_s
+
+    def deadline_for(self, t_submit: float, deadline_s: Optional[float]) -> Optional[float]:
+        """Absolute deadline timestamp for a request submitted at
+        ``t_submit`` (monotonic seconds), or ``None``."""
+        rel = deadline_s if deadline_s is not None else self.default_deadline_s
+        return None if rel is None else t_submit + float(rel)
+
+    def reject(self, queue_depth: int) -> ServingOverloaded:
+        """The typed rejection for a submit that found the queue full."""
+        return ServingOverloaded(
+            "queue-full", queue_depth=queue_depth, limit=self.max_queue
+        )
+
+    def expired(self, deadline: Optional[float], now: Optional[float] = None) -> bool:
+        """Deadline-aware shedding predicate: has this request's
+        absolute deadline passed? (Called at dequeue time — a request
+        that waited out its deadline is dropped before it wastes a
+        batch slot.)"""
+        if deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) > deadline
+
+    def shed(self, deadline: float, queue_depth: int) -> ServingOverloaded:
+        """The typed rejection delivered to a shed request's future."""
+        return ServingOverloaded("deadline", queue_depth=queue_depth, limit=deadline)

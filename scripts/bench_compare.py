@@ -78,6 +78,11 @@ HIGHER_IS_BETTER = {
     # speedup of the `*_2x8_dcn` rows (tests pin >= 2x; dp_step_quant_2x8
     # reuses dp_model_speedup)
     "tier_model_speedup",
+    # serving acceptance fields (ISSUE 9): sustained micro-batched QPS
+    # (serving_qps row) and the fresh-process AOT-load-vs-compile ratio
+    # (serving_coldstart row, target >= 10x on TPU rounds)
+    "qps",
+    "coldstart_speedup",
 }
 
 # rows that changed name across rounds: a baseline row under the old
@@ -102,6 +107,8 @@ LOWER_IS_BETTER = {
     # ISSUE 8: per-device bytes the tiered plans route over the
     # expensive tier — growth means movement regressed onto DCN
     "dcn_bytes",
+    # ISSUE 9: per-request p95 latency of the serving_qps row
+    "p95_s",
 }
 
 

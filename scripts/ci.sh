@@ -107,6 +107,27 @@ diff "$topo_a" "$topo_b"
 echo "HEAT_TPU_TOPOLOGY=auto on CPU: flat plans byte-identical"
 rm -f "$topo_a" "$topo_b"
 
+# serving legs (ISSUE 9): (19) warmup export into a fresh store, then a
+# FRESH process against the same store must serve every declared
+# program from disk (--expect-hits: the cross-process cache-hit proof —
+# an AOT-served cold start compiles 0 programs); (20) the dispatcher
+# concurrency + AOT suite FORCED on (HEAT_TPU_SERVING_AOT=1 with a
+# scratch store, so the ambient default-enabled hooks are exercised by
+# every test, not just the ServingCase-anchored ones); (21) the
+# HEAT_TPU_SERVING_AOT=0 escape hatch over the serving + jit suites —
+# hooks never install and the wrapper runs its exact pre-serving paths
+srv_store="$(mktemp -d)"
+HEAT_TPU_SERVING_AOT=1 HEAT_TPU_SERVING_CACHE="$srv_store" python scripts/warmup.py > /dev/null
+HEAT_TPU_SERVING_AOT=1 HEAT_TPU_SERVING_CACHE="$srv_store" python scripts/warmup.py --expect-hits
+echo "serving warmup reload: cross-process AOT hits OK"
+
+srv_scratch="$(mktemp -d)"
+HEAT_TPU_SERVING_AOT=1 HEAT_TPU_SERVING_CACHE="$srv_scratch" \
+  python -m pytest tests/test_serving.py -q "$@"
+rm -rf "$srv_store" "$srv_scratch"
+
+HEAT_TPU_SERVING_AOT=0 python -m pytest tests/test_serving.py tests/test_jit.py tests/test_jit_sweep.py -q "$@"
+
 python scripts/lint.py heat_tpu/
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \

@@ -142,3 +142,23 @@ def ppermute_ring_program(x):
     return shard_map(
         body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
     )(phys)
+
+
+def serving_sync_handler(x):
+    """SL106 (ISSUE 9): a serving request handler that reads device
+    VALUES on the host mid-request — a debug/logging sync buried in the
+    dispatch→result hot path. One such read serializes the dispatcher's
+    whole pipeline behind a host round trip (every queued request
+    behind it eats the latency), which is exactly why the serving
+    budget is ZERO undeclared ``device_get`` between dispatch and
+    result; the dispatcher's own fence is ``block_until_ready``
+    (completion, no transfer). ``ht.analysis.check`` aborts the trace
+    at the concretizing read and reports SL106; the source scan flags
+    the line even when the branch is untaken."""
+    import jax
+
+    y = x * 2.0
+    if getattr(serving_sync_handler, "_debug", True):
+        peek = jax.device_get(y._phys)  # shardlint: ignore[SL201] -- fixture
+        print("serving batch mean:", peek.mean())
+    return y + 1.0

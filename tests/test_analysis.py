@@ -193,6 +193,17 @@ class TestIRCheckBadFixture(TestCase):
         self.assertIn("SL106", rep.rule_ids)
         self.assertFalse(rep.ok)
 
+    def test_serving_sync_handler_trips_sl106(self):
+        """ISSUE 9 golden bad fixture: a BLOCKING host sync inside a
+        serving request handler — the dispatch→result hot path budget
+        is zero undeclared device_get, and the check aborts at the
+        concretizing read with SL106 at error severity."""
+        rep = ht.analysis.check(fx.serving_sync_handler, ht.random.randn(32, 8, split=0))
+        self.assertFalse(rep.ok)
+        sl106 = rep.by_rule("SL106")
+        self.assertTrue(sl106)
+        self.assertTrue(any(f.severity == "error" for f in sl106))
+
     def test_report_dict_shape(self):
         rep = ht.analysis.check(fx.widening_program, ht.random.randn(256, split=0))
         d = rep.as_dict()
