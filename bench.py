@@ -812,6 +812,23 @@ def measure_heat_tpu() -> dict:
             f["quant"] = plan.quant["mode"]
         return f
 
+    def _mem_fields(fn, *xs):
+        # static memory bounds (ISSUE 10): the memcheck liveness peak
+        # per device plus the compiler's own buffer-assignment numbers,
+        # compile-only. `static_peak_bytes` is GATED lower-is-better by
+        # scripts/bench_compare.py — a planner change that inflates the
+        # live set is caught pre-TPU; the xla_* fields are the
+        # cross-check context (tier-1 pins static/xla within 2x).
+        try:
+            ctx = ht.analysis.memcheck(fn, *xs).context
+            out = {"static_peak_bytes": int(ctx["static_peak_bytes"])}
+            for k in ("xla_temp_bytes", "xla_output_bytes"):
+                if ctx.get(k) is not None:
+                    out[k] = int(ctx[k])
+            return out
+        except Exception:
+            return {}
+
     # reshape there-and-back per step = 2 ops; slope halved. The legacy
     # `reshape` row is FOLDED into the planner-named `reshape_split1_1gb`
     # row (they were one measurement since PR 3, and the legacy name was
@@ -832,6 +849,9 @@ def measure_heat_tpu() -> dict:
     try:
         plan = ht.redistribution.explain(r, reshape=(10_000_000, 25), new_split=1)
         out["_reshape_plan"] = _plan_fields(plan)
+        out["_reshape_plan"].update(
+            _mem_fields(lambda y: ht.reshape(y, (10_000_000, -1), new_split=1), r)
+        )
     except Exception:
         out["_reshape_plan"] = {}
     del r
@@ -855,6 +875,9 @@ def measure_heat_tpu() -> dict:
     try:
         plan = ht.redistribution.explain(rl, reshape=LANE_OUT, new_split=1)
         out["_reshape_lane_plan"] = _plan_fields(plan)
+        out["_reshape_lane_plan"].update(
+            _mem_fields(lambda y: ht.reshape(y, LANE_OUT, new_split=1), rl)
+        )
     except Exception:
         out["_reshape_lane_plan"] = {}
     del rl
@@ -868,6 +891,7 @@ def measure_heat_tpu() -> dict:
     method["resplit_1gb"] = "chained-slope (pair, halved; interleaved with the sequential twin)"
     try:
         out["_resplit_plan"] = _plan_fields(ht.redistribution.explain(rsp, 1))
+        out["_resplit_plan"].update(_mem_fields(lambda y: y.resplit(1), rsp))
     except Exception:
         out["_resplit_plan"] = {}
     del rsp
@@ -1829,20 +1853,26 @@ def main() -> None:
             # ISSUE 6 overlap fields (`critical_path_model` = modeled
             # max-vs-sum speedup, `vs_sequential` = measured same-run
             # ratio) + the ISSUE 7 `wire_ratio` (encoded/raw wire bytes
-            # of the executing plan — the <= 0.5 acceptance gate): in
-            # the driver artifact so future rounds gate on them
+            # of the executing plan — the <= 0.5 acceptance gate) + the
+            # ISSUE 10 `static_peak_bytes` (memcheck's per-device
+            # liveness peak, gated lower-is-better so a planner change
+            # that inflates the live set is caught pre-TPU): in the
+            # driver artifact so future rounds gate on them
             "reshape_split1_1gb": pick(
                 "reshape_split1_1gb", "hbm_frac", "path", "critical_path_model",
-                "vs_sequential", "wire_ratio", "measurement_suspect",
+                "vs_sequential", "wire_ratio", "static_peak_bytes",
+                "measurement_suspect",
             ),
             "reshape_lane_1gb": (
                 pick("reshape_lane_1gb", "hbm_frac", "path", "critical_path_model",
-                     "vs_sequential", "wire_ratio", "measurement_suspect")
+                     "vs_sequential", "wire_ratio", "static_peak_bytes",
+                     "measurement_suspect")
                 if "reshape_lane_1gb" in detail else {}
             ),
             "resplit_1gb": pick(
                 "resplit_1gb", "hbm_frac", "path", "critical_path_model",
-                "vs_sequential", "wire_ratio", "measurement_suspect",
+                "vs_sequential", "wire_ratio", "static_peak_bytes",
+                "measurement_suspect",
             ),
             # ISSUE 7 analytic DP row (modeled, gated)
             "dp_step_quant": (

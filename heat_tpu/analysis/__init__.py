@@ -4,7 +4,7 @@ The north-star contract — compiled programs launch exactly the
 collectives the algorithm needs, every intermediate stays distributed,
 nothing round-trips through the host — is a *static* property of the
 traced program and the source tree. This package checks it before any
-TPU minute is spent, in two passes:
+TPU minute is spent, in three passes:
 
 - **Pass 1, IR lint** — :func:`ht.analysis.check(fn, *args) <check>`
   walks the jaxpr and compiled StableHLO of any heat_tpu program
@@ -17,6 +17,18 @@ TPU minute is spent, in two passes:
   the tree itself: no undeclared ``jax.device_get``, no bare
   ``jax.jit`` outside private program builders, public ops routed
   through ``core/sanitation.py``.
+- **Pass 3, memory lint** — :func:`ht.analysis.memcheck(fn, *args)
+  <memcheck>` abstract-interprets the jaxpr with a liveness analysis
+  (per-value local shard bytes, replication, live range) into a static
+  peak-HBM estimate per device, cross-checked against the compiler's
+  own ``memory_analysis()``: programs that cannot fit (SL301), declared
+  donations the executable silently dropped (SL302), and replicated
+  values held live across collective chains (SL303) are findings, not
+  OOMs. Its sibling :func:`ht.analysis.verify_plan(plan) <verify_plan>`
+  symbolically executes Schedule-IR redistribution plans and proves
+  composition, byte conservation, codec pairing, tier labels, overlap
+  lap structure and plan-id integrity — swept over every golden-matrix
+  plan in tier-1 and the ci.sh determinism leg.
 
 Legitimate host boundaries are declared, by name and category, in
 :mod:`~heat_tpu.analysis.boundaries` — the whitelist is code, reviewed
@@ -27,20 +39,27 @@ catalog and workflow: docs/PERF.md § Static analysis.
 from . import boundaries
 from . import findings
 from . import ircheck
+from . import planverify
 from . import srclint
 
 from .boundaries import HOST_BOUNDARIES, is_declared_sync
 from .findings import RULES, AnalysisReport, Finding
 from .ircheck import check
+from .memcheck import hbm_budget_bytes, memcheck
+from .planverify import PlanVerificationError, verify_plan
 from .srclint import lint_paths, lint_source
 
 __all__ = [
     "AnalysisReport",
     "Finding",
     "HOST_BOUNDARIES",
+    "PlanVerificationError",
     "RULES",
     "check",
+    "hbm_budget_bytes",
     "is_declared_sync",
     "lint_paths",
     "lint_source",
+    "memcheck",
+    "verify_plan",
 ]

@@ -1,0 +1,98 @@
+"""Donation resolution shared by the analyzer passes.
+
+Rule SL105 (ircheck: "output aliases an argument but the buffer is not
+donated") and rule SL302 (memcheck: "donation declared but the compiled
+executable dropped it") are two halves of one question — *which buffers
+did the caller donate, and did the pipeline actually reuse them?* Both
+passes used to answer the first half with their own bookkeeping walk;
+this module is the single resolver they now share, so the two rules can
+never disagree about what was donated.
+
+The resolution contract mirrors ``ht.jit`` exactly (core/jit.py): user
+``donate_argnums`` are USER-VISIBLE positional indices; each donated
+argument contributes the flattened traced leaves it spans (statics carry
+no buffer and drop out), and DNDarray leaves donate their padded
+physical arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set, Tuple
+
+import numpy as np
+
+__all__ = [
+    "declared_donate_argnums",
+    "donated_avals",
+    "donated_leaf_positions",
+]
+
+
+def declared_donate_argnums(fn, donate_argnums=None) -> Tuple[int, ...]:
+    """The user-visible positional argnums ``fn`` donates: the explicit
+    override when given, else the ``ht.jit`` wrapper's own bookkeeping
+    (``_ht_jit_donate_argnums``), else nothing."""
+    if donate_argnums is None:
+        donate_argnums = getattr(fn, "_ht_jit_donate_argnums", ())
+    if isinstance(donate_argnums, int):
+        donate_argnums = (donate_argnums,)
+    return tuple(int(u) for u in donate_argnums)
+
+
+def donated_avals(fn, args, donate_argnums=None) -> Set[Tuple[tuple, str]]:
+    """(shape, dtype-str) of every leaf of every donated positional arg —
+    the aval-level view rule SL105 keys on. DNDarray leaves contribute
+    their PADDED physical arrays (what the compiled program sees)."""
+    import jax
+
+    from ..core.jit import _is_leaf
+
+    donated: Set[Tuple[tuple, str]] = set()
+    for u in declared_donate_argnums(fn, donate_argnums):
+        if 0 <= u < len(args):
+            for leaf in jax.tree.leaves(args[u], is_leaf=_is_leaf):
+                phys = getattr(leaf, "_phys", leaf)  # DNDarray -> padded physical
+                shape = getattr(phys, "shape", None)
+                dtype = getattr(phys, "dtype", None)
+                if shape is not None and dtype is not None:
+                    donated.add((tuple(shape), str(np.dtype(dtype))))
+    return donated
+
+
+def donated_leaf_positions(fn, args, kwargs=None, donate_argnums=None) -> Tuple[int, ...]:
+    """Flat TRACED-leaf positions the donated args span — the same
+    user-arg -> traced-position mapping ``ht.jit`` builds at dispatch,
+    and therefore the XLA parameter numbers rule SL302 checks against
+    the compiled module's ``input_output_alias`` map. Static leaves
+    (non-array hashables) carry no buffer and are skipped."""
+    import jax
+
+    from ..core.dndarray import DNDarray
+    from ..core.jit import _is_leaf
+
+    donate_user = declared_donate_argnums(fn, donate_argnums)
+    if not donate_user:
+        return ()
+    kwargs = kwargs or {}
+    leaves, _ = jax.tree.flatten((args, kwargs), is_leaf=_is_leaf)
+    # the traced-leaf predicate of observability.hlo._build_traceable —
+    # the SAME trace both analyzer passes compile, so these positions
+    # ARE the compiled module's parameter numbers
+    is_traced = [isinstance(leaf, (DNDarray, jax.Array)) for leaf in leaves]
+    spans, off = [], 0
+    for a in args:
+        n = len(jax.tree.flatten(a, is_leaf=_is_leaf)[0])
+        spans.append(range(off, off + n))
+        off += n
+    traced_pos, t = {}, 0
+    for i, traced in enumerate(is_traced):
+        if traced:
+            traced_pos[i] = t
+            t += 1
+    return tuple(
+        traced_pos[i]
+        for u in donate_user
+        if 0 <= u < len(spans)
+        for i in spans[u]
+        if i in traced_pos
+    )

@@ -17,9 +17,10 @@ __all__ = ["SEVERITIES", "Finding", "AnalysisReport", "RULES"]
 SEVERITIES = ("error", "warning", "info")
 
 # rule id -> one-line contract. SL1xx = IR lint (compiled-program rules),
-# SL2xx = source lint (repo-invariant rules). docs/PERF.md carries the
-# narrative catalog; this dict is the machine-readable index the CLI and
-# tests key on.
+# SL2xx = source lint (repo-invariant rules), SL3xx = memory lint (the
+# memcheck abstract interpreter). docs/PERF.md carries the narrative
+# catalog; this dict is the machine-readable index the CLI and tests key
+# on.
 RULES: Dict[str, str] = {
     "SL101": "implicit-reshard: a large operand crosses the mesh through an "
              "all-to-all the algorithm did not ask for (input split disagrees "
@@ -54,6 +55,21 @@ RULES: Dict[str, str] = {
              "apply",
     "SL203": "unsanitized-public-op: a public op function does not route its "
              "inputs through core/sanitation.py (or delegate to a routed op)",
+    "SL301": "hbm-overcommit: the liveness-based static peak-HBM estimate of "
+             "the compiled program exceeds the per-device budget "
+             "(HEAT_TPU_HBM_BYTES; v5e 16 GiB default) — the program cannot "
+             "fit at dispatch, reject it at compile time (serving admission "
+             "raises ServingOverloaded(reason='hbm-estimate') from the same "
+             "number)",
+    "SL302": "dropped-donation: donation was declared but the compiled "
+             "executable's input_output_aliases never reuse the donated "
+             "buffer — both copies stay live in HBM while the caller "
+             "believes one was reclaimed (the executable-level upgrade of "
+             "SL105's 'should donate')",
+    "SL303": "replicated-live-range: a replicated value above the size "
+             "threshold stays live across >= 2 collective steps — a "
+             "per-device materialization whose residency the redistribution "
+             "planner's transient peak accounting never sees",
 }
 
 

@@ -122,31 +122,6 @@ def _trace_errors():
     )
 
 
-def _donated_avals(fn, args, donate_argnums) -> set:
-    """(shape, dtype-str) of every leaf of every donated positional arg.
-    Donation declared either to ``check`` directly or on an ``ht.jit``
-    wrapper (core/jit.py records its user-facing donate_argnums on the
-    wrapper — the cross-check that bookkeeping exists for)."""
-    import jax
-
-    from ..core.jit import _is_leaf
-
-    if donate_argnums is None:
-        donate_argnums = getattr(fn, "_ht_jit_donate_argnums", ())
-    if isinstance(donate_argnums, int):
-        donate_argnums = (donate_argnums,)
-    donated = set()
-    for u in donate_argnums:
-        if 0 <= u < len(args):
-            for leaf in jax.tree.leaves(args[u], is_leaf=_is_leaf):
-                phys = getattr(leaf, "_phys", leaf)  # DNDarray -> padded physical
-                shape = getattr(phys, "shape", None)
-                dtype = getattr(phys, "dtype", None)
-                if shape is not None and dtype is not None:
-                    donated.add((tuple(shape), str(np.dtype(dtype))))
-    return donated
-
-
 _REPLICA_GROUPS = re.compile(r"replica_groups=\{((?:\{[0-9, ]*\},?)+)\}")
 _REPLICA_IOTA = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+)\]")
 _SOURCE_TARGETS = re.compile(r"source_target_pairs=\{((?:\{[0-9, ]*\},?)+)\}")
@@ -632,8 +607,13 @@ def check(
     # with explicit donation bookkeeping the per-aval check below is the
     # authority (a PARTIALLY donated program still has missed donations to
     # report); only without it does module-level aliasing mean "the caller
-    # already donated through raw jax.jit" and silence the rule
-    donated = _donated_avals(fn, args, donate_argnums)
+    # already donated through raw jax.jit" and silence the rule. The
+    # donation resolver is SHARED with memcheck's SL302 (analysis._donation)
+    # so "should donate" and "donation dropped" can never disagree about
+    # what was declared.
+    from ._donation import donated_avals as _donated_avals_shared
+
+    donated = _donated_avals_shared(fn, args, donate_argnums)
     have_bookkeeping = bool(donated) or donate_argnums is not None
     if have_bookkeeping or "input_output_alias" not in text:
         in_set = set(in_avals)

@@ -1,0 +1,684 @@
+"""Schedule-IR plan verifier — ``ht.analysis.verify_plan``.
+
+The redistribution planner's golden matrix is pinned today by byte-level
+dump diffing (ci.sh runs ``scripts/redist_plans.py`` twice and diffs):
+that catches nondeterminism, but a plan that is *deterministically
+wrong* — corrupted accounting, a dropped dequantize step, a tier label
+that contradicts the topology — would diff clean forever. This module
+closes that gap: it symbolically executes a
+:class:`~heat_tpu.redistribution.schedule.Schedule` (or its parsed
+canonical-JSON dict) over abstract shard shapes and PROVES the plan
+well-formed, invariant by invariant:
+
+``composition``
+    the step sequence is one that takes ``spec.src`` to ``spec.dst``:
+    per-strategy symbolic templates over the step kinds (an a2a plan is
+    laps of slice→all-to-all→scatter; a pivot is stage-in → local
+    reshape → stage-out; a ring is exactly ``p-1`` ppermute hops; a
+    hierarchical plan alternates intra-slice/inter-slice exchanges),
+    with the spec-side preconditions (splits, reshape validity) checked
+    so the matched template provably ends at ``(out_shape, dst_split)``.
+``conservation``
+    per-step byte conservation: the collective payloads re-derived from
+    the spec's geometry (padded shard bytes, crossing fractions, lap
+    counts) equal the plan's recorded movement — exactly, including the
+    chunking floor-division the planner applies.
+``accounting``
+    the recorded ``peak_bytes``/``bytes_moved``/``bytes_copied``/
+    ``collective_counts``/``within_budget`` fields equal what the steps
+    recompute to (the liveness-based peak of the step list — see
+    :meth:`Schedule.liveness`).
+``quant-pairing``
+    every wire-codec collective sits inside a quantize → collective →
+    dequantize triple, codec steps appear iff the schedule carries a
+    ``quant`` annotation, and the annotation's ``bytes_raw``/
+    ``bytes_sent``/``ratio`` arithmetic is consistent (``wire_ratio``
+    is recomputed, not trusted).
+``tier-labels``
+    tier labels are consistent with the ``topology`` annotation (and
+    with an explicitly expected ``topology=`` argument): flat plans
+    carry no tiers, tiered flat-structure plans ride DCN end to end,
+    hierarchical plans carry both tiers in intra/inter order, and
+    ``n_slices * chips_per_slice == mesh_size``.
+``overlap-structure``
+    pipeline groups are well-formed laps: each group's tag anchors the
+    right number of collective laps, and the depth-2 critical-path
+    arithmetic (``w + (laps-1)·max(w, c) + c``; the tiered
+    ``max(ici, dcn·penalty, copy)`` form) reproduces the annotation.
+``plan-id``
+    the ``plan_id`` is the sha1 of the canonical serialization — a
+    hand-edited or bit-rotted dump cannot keep its id.
+
+Runs in pure Python (no mesh, no jax device work), so the ci.sh
+determinism leg sweeps it over every dumped golden plan — flat, 2x4,
+2x8, quant on and off — and tier-1 pins the same sweep in-process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+__all__ = ["PlanVerificationError", "verify_plan"]
+
+_COLLECTIVE_KINDS = ("all_to_all", "all_gather", "ppermute")
+_LOCAL_KINDS = (
+    "slice", "pad", "reshape", "concat", "pack", "unpack",
+    "quantize", "dequantize",
+)
+_CODEC_KINDS = ("quantize", "dequantize")
+
+
+class PlanVerificationError(ValueError):
+    """One violated plan invariant, named.
+
+    Attributes
+    ----------
+    invariant : the violated invariant's name (``composition``,
+        ``conservation``, ``accounting``, ``quant-pairing``,
+        ``tier-labels``, ``overlap-structure``, ``plan-id``,
+        ``step-kinds``).
+    detail : what exactly failed, with the offending numbers.
+    plan_id : the plan's id when known.
+    """
+
+    def __init__(self, invariant: str, detail: str, plan_id: Optional[str] = None):
+        self.invariant = invariant
+        self.detail = detail
+        self.plan_id = plan_id
+        where = f"plan {plan_id} " if plan_id else "plan "
+        super().__init__(f"{where}violates invariant '{invariant}': {detail}")
+
+
+def _pad_extent(n: int, p: int) -> int:
+    from ..core import _padding
+
+    return _padding.pad_extent(int(n), int(p))
+
+
+def _prod(shape) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _itemsize(dtype: str) -> int:
+    import numpy as np
+
+    return np.dtype(dtype).itemsize
+
+
+def _as_plan_dict(plan) -> Dict[str, Any]:
+    from ..redistribution.schedule import Schedule
+
+    if isinstance(plan, Schedule):
+        return plan.as_dict()
+    if isinstance(plan, str):
+        plan = json.loads(plan)
+    if not isinstance(plan, dict):
+        raise TypeError(
+            f"verify_plan expects a Schedule, a plan dict, or its JSON "
+            f"serialization — got {type(plan).__name__}"
+        )
+    return plan
+
+
+def _expected_topology(topology) -> Union[None, str, Tuple[int, int]]:
+    """Normalize the expected-topology argument: ``None`` = no
+    expectation (self-consistency only), ``"flat"`` = must be untiered,
+    ``"SxC"``/``(S, C)``/``Topology`` = must match."""
+    if topology is None:
+        return None
+    if isinstance(topology, str):
+        t = topology.strip().lower()
+        if t in ("flat", "1", ""):
+            return "flat"
+        parts = t.split("x")
+        if len(parts) == 2 and parts[0].isdigit() and parts[1].isdigit():
+            return (int(parts[0]), int(parts[1]))
+        raise ValueError(f"verify_plan: unknown topology expectation {topology!r}")
+    if isinstance(topology, tuple):
+        return (int(topology[0]), int(topology[1]))
+    n_slices = getattr(topology, "n_slices", None)
+    chips = getattr(topology, "chips_per_slice", None)
+    if n_slices is not None and chips is not None:
+        return (int(n_slices), int(chips)) if int(n_slices) > 1 else "flat"
+    raise TypeError(f"verify_plan: cannot interpret topology {topology!r}")
+
+
+def _stage_local_bytes(shape, axis: int, p: int, item: int) -> int:
+    """Per-device bytes of the doubly-padded buffer one pivot stage
+    exchanges (the planner's stage geometry: the stage's split axis
+    padded to divide the mesh)."""
+    padded = [
+        _pad_extent(d, p) if ax == axis else int(d) for ax, d in enumerate(shape)
+    ]
+    return _prod(padded) // p * item
+
+
+def verify_plan(
+    plan,
+    topology=None,
+    raise_on_violation: bool = True,
+) -> Dict[str, Any]:
+    """Verify one Schedule-IR plan against its invariants.
+
+    Parameters
+    ----------
+    plan : a :class:`~heat_tpu.redistribution.schedule.Schedule`, the
+        dict of its ``as_dict()``/canonical serialization, or that
+        serialization as a JSON string (what ``scripts/redist_plans.py``
+        dumps — the ci.sh sweep feeds those lines straight in).
+    topology : optional EXPECTED topology — ``"flat"`` (the plan must be
+        untiered), an ``"SxC"`` string / ``(S, C)`` tuple /
+        ``core.communication.Topology`` (the plan's annotation must
+        match). Default ``None`` checks self-consistency only.
+    raise_on_violation : raise :class:`PlanVerificationError` on the
+        first violated invariant (the CI mode — the violated invariant
+        is named in the exception); with ``False`` all violations are
+        collected into the returned report.
+
+    Returns ``{"ok", "plan_id", "strategy", "checks", "violations"}``;
+    ``checks`` lists every invariant that was evaluated.
+    """
+    d = _as_plan_dict(plan)
+    plan_id = d.get("plan_id")
+    violations: List[PlanVerificationError] = []
+
+    def fail(invariant: str, detail: str) -> None:
+        err = PlanVerificationError(invariant, detail, plan_id=plan_id)
+        if raise_on_violation:
+            raise err
+        violations.append(err)
+
+    spec = d.get("spec") or {}
+    strategy = d.get("strategy", "")
+    steps: List[Dict[str, Any]] = list(d.get("steps") or [])
+    gshape = tuple(int(v) for v in (spec.get("gshape") or ()))
+    out_shape = (
+        tuple(int(v) for v in spec["reshape_to"])
+        if spec.get("reshape_to") is not None
+        else gshape
+    )
+    is_reshape = spec.get("reshape_to") is not None
+    src = spec.get("src_split")
+    dst = spec.get("dst_split")
+    p = int(spec.get("mesh_size", 1))
+    item = _itemsize(spec.get("dtype", "float32"))
+    size = _prod(gshape)
+
+    # ---- step-kinds: the vocabulary itself ----------------------------
+    for k, st in enumerate(steps):
+        kind = st.get("kind")
+        if kind not in _COLLECTIVE_KINDS and kind not in _LOCAL_KINDS:
+            fail("step-kinds", f"step [{k}] has unknown kind {kind!r}")
+        if st.get("tier") not in (None, "ici", "dcn"):
+            fail("step-kinds", f"step [{k}] has unknown tier {st.get('tier')!r}")
+        for field in ("bytes_moved", "bytes_copied", "peak_bytes"):
+            if int(st.get(field, 0)) < 0:
+                fail("step-kinds", f"step [{k}] has negative {field}")
+        if kind in _LOCAL_KINDS and int(st.get("bytes_moved", 0)) != 0:
+            fail(
+                "step-kinds",
+                f"local step [{k}] ({kind}) claims bytes_moved="
+                f"{st['bytes_moved']} — only collectives move bytes",
+            )
+
+    coll = [st for st in steps if st.get("kind") in _COLLECTIVE_KINDS]
+
+    # ---- accounting: the recorded fields vs the steps -----------------
+    recomputed_peak = max((int(st.get("peak_bytes", 0)) for st in steps), default=0)
+    if int(d.get("peak_bytes", 0)) != recomputed_peak:
+        fail(
+            "accounting",
+            f"recorded peak_bytes={d.get('peak_bytes')} but the liveness "
+            f"recompute over the steps gives {recomputed_peak}",
+        )
+    from ..redistribution.schedule import Schedule as _Schedule
+
+    if isinstance(plan, _Schedule):
+        # the liveness hook must agree with the step accounting: resident
+        # shards + the recomputed transient peak
+        live = plan.liveness()
+        live_peak = max((e["transient_bytes"] for e in live), default=0)
+        if live_peak != recomputed_peak or plan.liveness_peak_bytes != (
+            plan.resident_bytes + recomputed_peak
+        ):
+            fail(
+                "accounting",
+                f"Schedule.liveness() peak {live_peak} (+resident "
+                f"{plan.resident_bytes}) disagrees with the step "
+                f"accounting peak {recomputed_peak}",
+            )
+    moved = sum(int(st.get("bytes_moved", 0)) for st in steps)
+    if int(d.get("bytes_moved", 0)) != moved:
+        fail(
+            "accounting",
+            f"recorded bytes_moved={d.get('bytes_moved')} != step sum {moved}",
+        )
+    copied = sum(int(st.get("bytes_copied", 0)) for st in steps)
+    if int(d.get("bytes_copied", 0)) != copied:
+        fail(
+            "accounting",
+            f"recorded bytes_copied={d.get('bytes_copied')} != step sum {copied}",
+        )
+    budget = int(d.get("budget_bytes", 0))
+    if budget < 1:
+        fail("accounting", f"budget_bytes={budget} is not positive")
+    if bool(d.get("within_budget")) != (recomputed_peak <= budget):
+        fail(
+            "accounting",
+            f"within_budget={d.get('within_budget')} contradicts peak "
+            f"{recomputed_peak} vs budget {budget}",
+        )
+    counts: Dict[str, int] = {}
+    op_of = {"all_to_all": "all-to-all", "all_gather": "all-gather",
+             "ppermute": "collective-permute"}
+    for st in coll:
+        op = op_of[st["kind"]]
+        counts[op] = counts.get(op, 0) + 1
+    if dict(d.get("collective_counts") or {}) != counts:
+        fail(
+            "accounting",
+            f"recorded collective_counts={d.get('collective_counts')} != "
+            f"step census {counts}",
+        )
+
+    # ---- quant-pairing ------------------------------------------------
+    quant = d.get("quant")
+    n_q = sum(1 for st in steps if st.get("kind") == "quantize")
+    n_dq = sum(1 for st in steps if st.get("kind") == "dequantize")
+    if (n_q or n_dq) and not quant:
+        fail(
+            "quant-pairing",
+            f"{n_q} quantize / {n_dq} dequantize steps but no schedule-"
+            "level quant annotation",
+        )
+    if n_q != n_dq:
+        fail("quant-pairing", f"{n_q} quantize steps vs {n_dq} dequantize steps")
+    for k, st in enumerate(steps):
+        if st.get("kind") == "quantize":
+            nxt = steps[k + 1] if k + 1 < len(steps) else None
+            nxt2 = steps[k + 2] if k + 2 < len(steps) else None
+            if nxt is None or nxt.get("kind") not in _COLLECTIVE_KINDS:
+                fail(
+                    "quant-pairing",
+                    f"quantize step [{k}] is not followed by a collective "
+                    "(the encoded wire has no consumer)",
+                )
+            elif nxt2 is None or nxt2.get("kind") != "dequantize":
+                fail(
+                    "quant-pairing",
+                    f"wire-codec collective [{k + 1}] is not followed by a "
+                    "dequantize (the received blocks stay encoded)",
+                )
+    if quant:
+        mode = quant.get("mode")
+        if mode not in ("int8", "bf16"):
+            fail("quant-pairing", f"unknown wire-codec mode {mode!r}")
+        if n_q == 0:
+            fail("quant-pairing", "quant annotation present but no quantize step")
+        raw_q, sent_q = int(quant.get("bytes_raw", -1)), int(quant.get("bytes_sent", -1))
+        if raw_q < sent_q or sent_q < 0:
+            fail(
+                "quant-pairing",
+                f"quant annotation bytes_raw={raw_q} < bytes_sent={sent_q} "
+                "(the codec cannot inflate the wire)",
+            )
+        if sent_q != moved:
+            fail(
+                "quant-pairing",
+                f"quant annotation bytes_sent={sent_q} != the steps' wire "
+                f"total {moved}",
+            )
+        want_ratio = round(sent_q / raw_q, 4) if raw_q else 1.0
+        if abs(float(quant.get("ratio", -1)) - want_ratio) > 1e-9:
+            fail(
+                "quant-pairing",
+                f"quant ratio={quant.get('ratio')} != recomputed "
+                f"{want_ratio} (wire_ratio arithmetic is not consistent)",
+            )
+
+    # ---- tier-labels --------------------------------------------------
+    topo = d.get("topology")
+    expected = _expected_topology(topology)
+    if expected == "flat" and topo is not None:
+        fail(
+            "tier-labels",
+            f"expected a flat plan but the schedule carries topology {topo}",
+        )
+    if isinstance(expected, tuple):
+        got = (
+            (int(topo["n_slices"]), int(topo["chips_per_slice"])) if topo else None
+        )
+        # the planner's own resolution semantics: a forced SxC that does
+        # not factor THIS spec's mesh falls back to flat, and plans that
+        # launch no collectives never carry the annotation at all
+        want = expected if (expected[0] * expected[1] == p and coll) else None
+        if got != want:
+            fail(
+                "tier-labels",
+                f"expected topology "
+                f"{want and f'{want[0]}x{want[1]}' or 'flat'} (from "
+                f"{expected[0]}x{expected[1]} over a {p}-device mesh) but "
+                f"the schedule carries {got and f'{got[0]}x{got[1]}'}",
+            )
+    if topo is not None:
+        S, C = int(topo.get("n_slices", 0)), int(topo.get("chips_per_slice", 0))
+        if S < 2 or C < 1 or S * C != p:
+            fail(
+                "tier-labels",
+                f"topology annotation {S}x{C} does not factor the mesh "
+                f"(mesh_size {p})",
+            )
+        if int(topo.get("dcn_penalty", 0)) < 1:
+            fail("tier-labels", f"dcn_penalty={topo.get('dcn_penalty')} is not >= 1")
+    tiers = [st.get("tier") for st in coll]
+    if topo is None:
+        if any(t is not None for t in tiers):
+            fail(
+                "tier-labels",
+                "tier labels present on a flat plan (no topology annotation)",
+            )
+    else:
+        if any(t is None for t in tiers):
+            fail(
+                "tier-labels",
+                "a tiered plan's collectives must all carry a tier label",
+            )
+        if strategy == "hierarchical-a2a":
+            # intra-slice pivot first, inter-slice exchange second — per lap
+            if tiers[0::2] != ["ici"] * len(tiers[0::2]) or tiers[1::2] != [
+                "dcn"
+            ] * len(tiers[1::2]):
+                fail(
+                    "tier-labels",
+                    f"hierarchical-a2a tiers must alternate ici,dcn per lap "
+                    f"— got {tiers}",
+                )
+        elif any(t != "dcn" for t in tiers):
+            fail(
+                "tier-labels",
+                f"a slice-spanning flat-structure plan rides DCN end to end "
+                f"— got tiers {tiers}",
+            )
+    for k, st in enumerate(steps):
+        if st.get("kind") not in _COLLECTIVE_KINDS and st.get("tier") is not None:
+            fail("tier-labels", f"local step [{k}] ({st['kind']}) carries a tier")
+
+    # ---- composition: src must compose to dst -------------------------
+    kinds = [st["kind"] for st in steps if st.get("kind") not in _CODEC_KINDS]
+    coll_kinds = [k for k in kinds if k in _COLLECTIVE_KINDS]
+
+    def _compose() -> Optional[str]:
+        if strategy == "noop":
+            if steps:
+                return "a noop plan must have no steps"
+            if src != dst or (is_reshape and gshape != out_shape):
+                return "a noop plan must not change split or shape"
+        elif strategy == "local":
+            if p > 1 and size > 0:
+                return f"a local plan needs a 1-device mesh or empty array (p={p})"
+        elif strategy == "slice":
+            if src is not None or dst is None:
+                return f"slice serves replicated->split only (src={src}, dst={dst})"
+            if coll_kinds:
+                return f"slice must launch no collectives — got {coll_kinds}"
+        elif strategy == "replicate":
+            if dst is not None:
+                return f"replicate must end replicated (dst={dst})"
+            if coll_kinds != ["all_gather"]:
+                return f"replicate is ONE all-gather — got {coll_kinds}"
+        elif strategy == "gather-reshape":
+            if coll_kinds != ["all_gather"]:
+                return f"gather-reshape is ONE all-gather — got {coll_kinds}"
+            if is_reshape and "reshape" not in kinds:
+                return "gather-reshape never reshapes the gathered array"
+        elif strategy == "local-reshape":
+            if coll_kinds:
+                return f"local-reshape must launch no collectives — got {coll_kinds}"
+        elif strategy in ("all-to-all", "chunked-all-to-all"):
+            if is_reshape:
+                return "a pure-resplit strategy cannot serve a reshape spec"
+            if src is None or dst is None or src == dst:
+                return f"resplit needs two distinct splits (src={src}, dst={dst})"
+            if not coll_kinds or set(coll_kinds) != {"all_to_all"}:
+                return f"the exchange must be all-to-all laps — got {coll_kinds}"
+            if strategy == "chunked-all-to-all" and len(coll_kinds) < 2:
+                return "a chunked plan needs >= 2 laps"
+        elif strategy == "ring":
+            if is_reshape:
+                return "ring serves pure resplits only"
+            if coll_kinds != ["ppermute"] * (p - 1):
+                return (
+                    f"ring is exactly p-1={p - 1} ppermute hops — got "
+                    f"{len(coll_kinds)} of {sorted(set(coll_kinds))}"
+                )
+        elif strategy in ("split0-pivot", "packed-pivot"):
+            if not is_reshape:
+                return "the pivot serves reshape-with-repartition specs only"
+            if kinds.count("reshape") != 1:
+                return (
+                    f"the pivot has exactly one local reshape at full width "
+                    f"— got {kinds.count('reshape')}"
+                )
+            if not gshape or not out_shape:
+                return "the pivot needs non-scalar source and target shapes"
+            if gshape[0] % p or out_shape[0] % p:
+                return (
+                    f"pivot divisibility violated: leading extents "
+                    f"{gshape[0]}/{out_shape[0]} must divide p={p}"
+                )
+            if set(coll_kinds) - {"all_to_all"}:
+                return f"pivot stages exchange via all-to-all — got {coll_kinds}"
+            piv = kinds.index("reshape")
+            n_in = sum(1 for k in kinds[:piv] if k in _COLLECTIVE_KINDS)
+            n_out = sum(1 for k in kinds[piv:] if k in _COLLECTIVE_KINDS)
+            if (src not in (None, 0)) != (n_in > 0):
+                return (
+                    f"stage-in mismatch: src_split={src} but {n_in} "
+                    "collectives before the pivot reshape"
+                )
+            if (dst not in (None, 0)) != (n_out > 0):
+                return (
+                    f"stage-out mismatch: dst_split={dst} but {n_out} "
+                    "collectives after the pivot reshape"
+                )
+        elif strategy == "hierarchical-a2a":
+            if topo is None:
+                return "hierarchical-a2a requires a topology annotation"
+            if set(coll_kinds) != {"all_to_all"}:
+                return f"hierarchical laps exchange via all-to-all — got {coll_kinds}"
+            if len(coll_kinds) % 2:
+                return (
+                    f"hierarchical laps come in intra/inter pairs — got "
+                    f"{len(coll_kinds)} collectives"
+                )
+        else:
+            return f"unknown strategy {strategy!r}"
+        return None
+
+    detail = _compose()
+    if detail is not None:
+        fail("composition", detail)
+
+    # ---- conservation: movement re-derived from the spec geometry -----
+    raw_total = int(quant["bytes_raw"]) if quant else moved
+
+    def _expected_raw() -> Optional[int]:
+        if strategy in ("noop", "local", "slice", "local-reshape"):
+            return 0
+        if strategy in ("replicate", "gather-reshape"):
+            return size * item * (p - 1) // p
+        if strategy in ("all-to-all", "chunked-all-to-all") or (
+            strategy == "hierarchical-a2a" and not is_reshape
+        ):
+            shape = list(gshape)
+            shape[src] = _pad_extent(shape[src], p)
+            shape[dst] = _pad_extent(shape[dst], p)
+            L = _prod(shape) // p * item
+            if strategy == "hierarchical-a2a":
+                S, C = int(topo["n_slices"]), int(topo["chips_per_slice"])
+                K = max(len(coll_kinds) // 2, 1)
+                return (L * (C - 1) // C // K) * K + (L * (S - 1) // S // K) * K
+            Cn = max(len(coll_kinds), 1)
+            return (L * (p - 1) // p // Cn) * Cn
+        if strategy == "ring":
+            shape = list(gshape)
+            shape[src] = _pad_extent(shape[src], p)
+            shape[dst] = _pad_extent(shape[dst], p)
+            L = _prod(shape) // p * item
+            return (L // p) * (p - 1)
+        if strategy in ("split0-pivot", "packed-pivot") or (
+            strategy == "hierarchical-a2a" and is_reshape
+        ):
+            piv = kinds.index("reshape") if "reshape" in kinds else len(kinds)
+            pos = [i for i, k in enumerate(kinds) if k in _COLLECTIVE_KINDS]
+            n_in = sum(1 for i in pos if i < piv)
+            n_out = len(pos) - n_in
+            hier = strategy == "hierarchical-a2a"
+            total = 0
+            for n_stage, shape, axis in (
+                (n_in, gshape, src),
+                (n_out, out_shape, dst),
+            ):
+                if not n_stage:
+                    continue
+                L = _stage_local_bytes(shape, axis, p, item)
+                if hier:
+                    S, C = int(topo["n_slices"]), int(topo["chips_per_slice"])
+                    K = max(n_stage // 2, 1)
+                    total += (L * (C - 1) // C // K) * K + (L * (S - 1) // S // K) * K
+                else:
+                    total += (L * (p - 1) // p // n_stage) * n_stage
+            return total
+        return None
+
+    try:
+        expected_raw = _expected_raw()
+    except (TypeError, IndexError, KeyError, ZeroDivisionError) as e:
+        expected_raw = None
+        fail(
+            "conservation",
+            f"the spec geometry of strategy {strategy} is underivable "
+            f"({type(e).__name__}: {e}) — spec and strategy disagree",
+        )
+    if expected_raw is not None and expected_raw != raw_total:
+        fail(
+            "conservation",
+            f"strategy {strategy} over {spec} must move {expected_raw} raw "
+            f"wire bytes per device — the plan records {raw_total}",
+        )
+
+    # ---- overlap-structure --------------------------------------------
+    overlap = d.get("overlap")
+    if overlap:
+        if int(overlap.get("depth", 0)) != 2:
+            fail("overlap-structure", f"unsupported pipeline depth {overlap.get('depth')}")
+        groups = list(overlap.get("groups") or [])
+        if not groups:
+            fail("overlap-structure", "overlap annotation with no groups")
+        seq_sum = sum(int(g.get("sequential_bytes", 0)) for g in groups)
+        cp_sum = sum(int(g.get("critical_path_bytes", 0)) for g in groups)
+        if int(overlap.get("sequential_bytes", -1)) != seq_sum:
+            fail(
+                "overlap-structure",
+                f"annotation sequential_bytes={overlap.get('sequential_bytes')} "
+                f"!= group sum {seq_sum}",
+            )
+        if int(overlap.get("critical_path_bytes", -1)) != cp_sum:
+            fail(
+                "overlap-structure",
+                f"annotation critical_path_bytes="
+                f"{overlap.get('critical_path_bytes')} != group sum {cp_sum}",
+            )
+        if cp_sum and abs(
+            float(overlap.get("model_speedup", -1)) - round(seq_sum / cp_sum, 4)
+        ) > 1e-9:
+            fail(
+                "overlap-structure",
+                f"model_speedup={overlap.get('model_speedup')} != recomputed "
+                f"{round(seq_sum / cp_sum, 4)}",
+            )
+        lap_mult = 2 if strategy == "hierarchical-a2a" else 1
+        for g in groups:
+            tag, laps = g.get("tag"), int(g.get("laps", 0))
+            anchored = sum(
+                1
+                for st in steps
+                if st.get("kind") in _COLLECTIVE_KINDS and st.get("overlap") == tag
+            )
+            if anchored != laps * lap_mult:
+                fail(
+                    "overlap-structure",
+                    f"group {tag!r} models {laps} lap(s) but {anchored} "
+                    f"collective step(s) carry the tag (expected "
+                    f"{laps * lap_mult})",
+                )
+            wire, copy = int(g.get("wire_bytes", 0)), int(g.get("copy_bytes", 0))
+            seq_g, cp_g = int(g.get("sequential_bytes", -1)), int(
+                g.get("critical_path_bytes", -1)
+            )
+            if seq_g != wire + copy:
+                fail(
+                    "overlap-structure",
+                    f"group {tag!r} sequential_bytes={seq_g} != wire+copy "
+                    f"{wire + copy}",
+                )
+            if laps >= 2:
+                if "ici_bytes" in g:
+                    pen = int(g.get("dcn_penalty", 1))
+                    ici, dcn = int(g.get("ici_bytes", 0)), int(g.get("dcn_bytes", 0))
+                    if wire != ici + dcn * pen:
+                        fail(
+                            "overlap-structure",
+                            f"tiered group {tag!r} wire_bytes={wire} != "
+                            f"ici + dcn·penalty = {ici + dcn * pen}",
+                        )
+                    wi, wd, c = ici // laps, dcn * pen // laps, copy // laps
+                    want_cp = wi + wd + c + (laps - 1) * max(wi, wd, c)
+                else:
+                    w, c = wire // laps, copy // laps
+                    want_cp = w + (laps - 1) * max(w, c) + c
+                if cp_g != want_cp:
+                    fail(
+                        "overlap-structure",
+                        f"group {tag!r} critical_path_bytes={cp_g} != the "
+                        f"depth-2 model {want_cp}",
+                    )
+                if cp_g >= seq_g:
+                    fail(
+                        "overlap-structure",
+                        f"group {tag!r} models no gain (critical path "
+                        f"{cp_g} >= sequential {seq_g}) — the planner drops "
+                        "such groups",
+                    )
+
+    # ---- plan-id: the sha1 of the canonical serialization -------------
+    if plan_id is not None:
+        stripped = {k: v for k, v in d.items() if k != "plan_id"}
+        canonical = json.dumps(stripped, sort_keys=True, separators=(",", ":"))
+        want = hashlib.sha1(canonical.encode()).hexdigest()[:12]
+        if want != plan_id:
+            fail(
+                "plan-id",
+                f"plan_id {plan_id} != sha1 of the canonical serialization "
+                f"({want}) — the plan was edited after stamping",
+            )
+
+    checks = [
+        "step-kinds", "accounting", "quant-pairing", "tier-labels",
+        "composition", "conservation", "overlap-structure", "plan-id",
+    ]
+    return {
+        "ok": not violations,
+        "plan_id": plan_id,
+        "strategy": strategy,
+        "checks": checks,
+        "violations": [
+            {"invariant": v.invariant, "detail": v.detail} for v in violations
+        ],
+    }

@@ -42,6 +42,7 @@ Limitations (clear errors, not wrong answers):
 from __future__ import annotations
 
 import functools
+import re
 import time
 import warnings
 
@@ -49,12 +50,15 @@ import numpy as np
 
 import jax
 
-from typing import Callable, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from .dndarray import DNDarray
 from ..observability import events as _obs_events
 from ..observability import telemetry as _telemetry
 
+# __all__ stays ["jit"]: the executable_* introspection helpers below
+# are the analyzer's module-level readers (heat_tpu.analysis.memcheck),
+# not part of the star-exported array API surface.
 __all__ = ["jit"]
 
 
@@ -90,6 +94,100 @@ def aot_hooks():
 
 def _is_leaf(x) -> bool:
     return isinstance(x, DNDarray)
+
+
+# ---------------------------------------------------------------------- #
+# executable introspection (ISSUE 10)                                    #
+# ---------------------------------------------------------------------- #
+# The analyzer's memory pass (heat_tpu.analysis.memcheck) needs two
+# facts only the COMPILED executable knows: did XLA actually honor the
+# declared donations (input_output_alias), and what does the compiler's
+# own buffer assignment say the program needs (memory_analysis). Both
+# readers live here, next to the donation bookkeeping they audit.
+
+# "{0}: (2, {}, may-alias)" entries inside the module header's
+# input_output_alias={...} block
+_ALIAS_ENTRY = re.compile(
+    r"\{\s*([0-9,\s]*)\}\s*:\s*\(\s*(\d+)\s*,\s*\{([0-9,\s]*)\}\s*,\s*([a-z-]+)\s*\)"
+)
+
+
+def executable_input_output_aliases(compiled_or_text) -> List[Dict[str, Any]]:
+    """Parsed ``input_output_alias`` map of a compiled module: one
+    ``{"output_index", "param_number", "param_index", "kind"}`` entry
+    per aliased buffer, empty when the executable aliases nothing —
+    which is exactly how XLA reports a donation it could not use
+    ("donation silently dropped", rule SL302). ``param_number`` indexes
+    the module's flat parameters, i.e. the traced leaf positions
+    ``ht.jit``'s donation mapping produces."""
+    text = (
+        compiled_or_text
+        if isinstance(compiled_or_text, str)
+        else compiled_or_text.as_text()
+    )
+    start = text.find("input_output_alias={")
+    if start < 0:
+        return []
+    i = start + len("input_output_alias=")
+    depth = 0
+    end = i
+    for k in range(i, len(text)):
+        if text[k] == "{":
+            depth += 1
+        elif text[k] == "}":
+            depth -= 1
+            if depth == 0:
+                end = k + 1
+                break
+    out = []
+    for m in _ALIAS_ENTRY.finditer(text[i:end]):
+        out.append(
+            {
+                "output_index": tuple(
+                    int(v) for v in m.group(1).split(",") if v.strip()
+                ),
+                "param_number": int(m.group(2)),
+                "param_index": tuple(
+                    int(v) for v in m.group(3).split(",") if v.strip()
+                ),
+                "kind": m.group(4),
+            }
+        )
+    return out
+
+
+def executable_memory_stats(compiled) -> Optional[Dict[str, int]]:
+    """The compiler's own per-device buffer assignment of a compiled
+    executable (``Compiled.memory_analysis()``), normalized to plain
+    ints: argument/output/temp/alias bytes. ``None`` when the backend
+    does not report it — callers treat the stats as a cross-check, never
+    a requirement."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    fields = {
+        "argument_bytes": "argument_size_in_bytes",
+        "output_bytes": "output_size_in_bytes",
+        "temp_bytes": "temp_size_in_bytes",
+        "alias_bytes": "alias_size_in_bytes",
+    }
+    out: Dict[str, int] = {}
+    for key, attr in fields.items():
+        v = getattr(ma, attr, None)
+        if v is None:
+            return None
+        out[key] = int(v)
+    # what the buffer assignment says one device needs live at once:
+    # arguments + outputs + transients, minus the aliased reuse
+    out["peak_bytes"] = max(
+        0,
+        out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+        - out["alias_bytes"],
+    )
+    return out
 
 
 class _DndSpec:

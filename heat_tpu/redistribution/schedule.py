@@ -330,6 +330,43 @@ class Schedule:
             return None
         return (int(self.topology["n_slices"]), int(self.topology["chips_per_slice"]))
 
+    # ------------------------------------------------------------------ #
+    # liveness (ISSUE 10): the per-step live-byte account memcheck and   #
+    # the plan verifier reason over                                      #
+    # ------------------------------------------------------------------ #
+    @property
+    def resident_bytes(self) -> int:
+        """Per-device bytes RESIDENT for the whole redistribution: the
+        source shard being consumed plus the destination shard being
+        built. ``peak_bytes`` deliberately excludes them (it budgets the
+        chunkable transients); the liveness view adds them back so the
+        number is comparable with a whole-program peak-HBM estimate
+        (``ht.analysis.memcheck``)."""
+        return int(self.spec.src_shard_bytes) + int(self.spec.dst_shard_bytes)
+
+    def liveness(self) -> List[Dict[str, int]]:
+        """Per-step live-byte account: ``{"kind", "transient_bytes",
+        "live_bytes"}`` per step, where ``live_bytes`` = resident source
+        + destination shards + this step's transient. The recomputed
+        ``max(transient_bytes)`` must equal :attr:`peak_bytes` — one of
+        the invariants ``ht.analysis.verify_plan`` proves."""
+        resident = self.resident_bytes
+        return [
+            {
+                "kind": s.kind,
+                "transient_bytes": int(s.peak_bytes),
+                "live_bytes": resident + int(s.peak_bytes),
+            }
+            for s in self.steps
+        ]
+
+    @property
+    def liveness_peak_bytes(self) -> int:
+        """Max ``live_bytes`` over the steps (``resident_bytes`` for an
+        empty plan) — the schedule-level analog of memcheck's static
+        peak estimate."""
+        return self.resident_bytes + self.peak_bytes
+
     def tier_bytes(self) -> Dict[str, int]:
         """Per-tier collective payload split: ``{"ici": B, "dcn": B}``.
         Flat plans (every pre-topology schedule) report all movement as

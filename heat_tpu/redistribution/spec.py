@@ -125,6 +125,19 @@ class RedistSpec:
         phys = _padding.phys_shape(self.out_shape, self.dst_split, self.mesh_size)
         return _prod(phys) * self.itemsize // self.mesh_size
 
+    @property
+    def src_shard_bytes(self) -> int:
+        """Per-device bytes of one (padded) shard of the SOURCE — with
+        :attr:`dst_shard_bytes` the resident baseline a redistribution
+        holds live on top of every step's transient (the liveness
+        account ``Schedule.liveness`` exposes)."""
+        from ..core import _padding
+
+        if self.src_split is None or self.mesh_size <= 1:
+            return self.logical_bytes
+        phys = _padding.phys_shape(self.gshape, self.src_split, self.mesh_size)
+        return _prod(phys) * self.itemsize // self.mesh_size
+
     def as_dict(self) -> dict:
         return {
             "gshape": list(self.gshape),

@@ -35,22 +35,33 @@ class ServingOverloaded(RuntimeError):
     ----------
     reason : ``"queue-full"`` (rejected at submit: the bounded queue is
         at depth limit), ``"deadline"`` (shed at dequeue: the request's
-        deadline passed while it waited), or ``"shutdown"`` (the
+        deadline passed while it waited), ``"shutdown"`` (the
         dispatcher stopped before serving the queued request — retry
-        against a live replica, do NOT back off as if overloaded).
+        against a live replica, do NOT back off as if overloaded), or
+        ``"hbm-estimate"`` (rejected at submit: the endpoint program's
+        STATIC peak-HBM estimate — ``ht.analysis.memcheck``'s
+        ``static_peak_bytes`` — exceeds the per-device budget, so the
+        request would OOM, not queue; route it to a bigger replica).
     queue_depth : observed queue depth at decision time.
-    limit : the configured bound that was hit (queue capacity, or the
-        deadline in seconds for shed requests; ``None`` for shutdown).
+    limit : the configured bound that was hit (queue capacity, the
+        deadline in seconds for shed requests, or the HBM budget in
+        bytes for memory rejections; ``None`` for shutdown).
+    static_peak_bytes : the program's static peak-HBM estimate, set on
+        ``"hbm-estimate"`` rejections only.
     """
 
     def __init__(self, reason: str, queue_depth: Optional[int] = None,
-                 limit: Optional[float] = None):
+                 limit: Optional[float] = None,
+                 static_peak_bytes: Optional[int] = None):
         self.reason = reason
         self.queue_depth = queue_depth
         self.limit = limit
+        self.static_peak_bytes = static_peak_bytes
         detail = f"serving overloaded ({reason})"
         if queue_depth is not None:
             detail += f": queue depth {queue_depth}"
+        if static_peak_bytes is not None:
+            detail += f": static peak-HBM estimate {static_peak_bytes} B"
         if limit is not None:
             detail += f" >= limit {limit}"
         super().__init__(detail)
@@ -67,14 +78,22 @@ class AdmissionControl:
         client thread behind an unbounded backlog).
     default_deadline_s : deadline applied to requests that do not carry
         their own (``None`` = no deadline: never shed).
+    hbm_limit_bytes : per-device HBM budget an endpoint program's STATIC
+        peak estimate (``ht.analysis.memcheck`` → ``static_peak_bytes``,
+        carried by the endpoint) must fit under; default ``None``
+        resolves ``HEAT_TPU_HBM_BYTES`` (v5e 16 GiB) lazily. The check
+        only engages for endpoints that DECLARE an estimate — with no
+        estimate every code path is exactly the pre-memcheck one.
     """
 
     def __init__(self, max_queue: int = 64,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 hbm_limit_bytes: Optional[int] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.max_queue = int(max_queue)
         self.default_deadline_s = default_deadline_s
+        self.hbm_limit_bytes = None if hbm_limit_bytes is None else int(hbm_limit_bytes)
 
     def deadline_for(self, t_submit: float, deadline_s: Optional[float]) -> Optional[float]:
         """Absolute deadline timestamp for a request submitted at
@@ -100,3 +119,30 @@ class AdmissionControl:
     def shed(self, deadline: float, queue_depth: int) -> ServingOverloaded:
         """The typed rejection delivered to a shed request's future."""
         return ServingOverloaded("deadline", queue_depth=queue_depth, limit=deadline)
+
+    def _hbm_budget(self) -> int:
+        if self.hbm_limit_bytes is not None:
+            return self.hbm_limit_bytes
+        from ..analysis.memcheck import hbm_budget_bytes
+
+        return hbm_budget_bytes()
+
+    def over_memory(self, static_peak_bytes: Optional[int]) -> bool:
+        """Memory admission predicate: does the endpoint program's
+        static peak-HBM estimate exceed the budget? ``None`` (no
+        estimate declared) never rejects — the check is opt-in per
+        endpoint."""
+        if static_peak_bytes is None:
+            return False
+        return int(static_peak_bytes) > self._hbm_budget()
+
+    def reject_memory(self, static_peak_bytes: int) -> ServingOverloaded:
+        """The typed rejection for a program that statically cannot fit:
+        ``reason="hbm-estimate"``, ``limit`` = the HBM budget in bytes.
+        Load balancers route these to a bigger replica instead of
+        backing off."""
+        return ServingOverloaded(
+            "hbm-estimate",
+            limit=self._hbm_budget(),
+            static_peak_bytes=int(static_peak_bytes),
+        )

@@ -81,11 +81,18 @@ class Endpoint:
         cluster centers) — replicated model state, not batched data.
     place : host batch -> device array (default: ``jnp.asarray``); an
         estimator endpoint shards over its communicator's mesh here.
+    static_peak_bytes : optional static peak-HBM estimate of the
+        endpoint's largest-bucket program (``ht.analysis.memcheck`` →
+        ``context["static_peak_bytes"]``). When set, the dispatcher's
+        admission control rejects submissions whose program statically
+        cannot fit with a typed
+        ``ServingOverloaded(reason="hbm-estimate")`` instead of letting
+        the dispatch OOM; ``None`` (the default) skips the check.
     """
 
     def __init__(self, programs: Dict[int, Callable], feature_shape: Tuple[int, ...],
                  dtype, extra_args: tuple = (), place: Optional[Callable] = None,
-                 name: str = "endpoint"):
+                 name: str = "endpoint", static_peak_bytes: Optional[int] = None):
         if not programs:
             raise ValueError("an Endpoint needs at least one bucket program")
         self.programs = dict(programs)
@@ -97,6 +104,9 @@ class Endpoint:
         self.extra_args = tuple(extra_args)
         self.place = place if place is not None else (lambda batch: jnp.asarray(batch))
         self.name = name
+        self.static_peak_bytes = (
+            None if static_peak_bytes is None else int(static_peak_bytes)
+        )
 
     @property
     def max_rows(self) -> int:
@@ -229,6 +239,17 @@ class Dispatcher:
                 f"request rows {rows} outside [1, {self.endpoint.max_rows}] "
                 "(the endpoint's largest bucket)"
             )
+        # memory admission (ISSUE 10): an endpoint that DECLARES its
+        # static peak (ht.analysis.memcheck) is rejected typed when the
+        # program cannot fit the per-device HBM budget — a dispatch that
+        # would OOM must never reach the accelerator
+        peak = self.endpoint.static_peak_bytes
+        if self.admission.over_memory(peak):
+            with self._counts_lock:
+                self._counts["rejected"] += 1
+            if _telemetry._ENABLED:
+                _telemetry.inc("serving.admission.rejected")
+            raise self.admission.reject_memory(peak)
         now = time.monotonic()
         req = _Request(x, rows, Future(), now, self.admission.deadline_for(now, deadline_s))
         try:
@@ -411,14 +432,17 @@ class Dispatcher:
 def program_endpoint(build, example_feature_shape, dtype, buckets: Sequence[int],
                      key: tuple, extra_args: tuple = (), place: Optional[Callable] = None,
                      input_sharding=None, donate: bool = False,
-                     name: str = "program") -> Endpoint:
+                     name: str = "program",
+                     static_peak_bytes: Optional[int] = None) -> Endpoint:
     """An :class:`Endpoint` over an arbitrary program builder.
 
     ``build()`` returns the jitted program ``(batch, *extra_args) ->
     result``; each bucket's callable is resolved through the persistent
     AOT cache (:func:`heat_tpu.serving.aot_cache.ensure_program`) under
     ``key + (bucket,)`` — a warm process loads every bucket without
-    tracing. ``donate=True`` donates the batch slab (argument 0)."""
+    tracing. ``donate=True`` donates the batch slab (argument 0).
+    ``static_peak_bytes`` (optional, from ``ht.analysis.memcheck``)
+    arms the dispatcher's HBM admission check."""
     feature_shape = tuple(int(s) for s in example_feature_shape)
     dtype = np.dtype(dtype)
     extra_sds = _aot._input_sds(extra_args)
@@ -431,7 +455,7 @@ def program_endpoint(build, example_feature_shape, dtype, buckets: Sequence[int]
         )
         programs[b] = call
     return Endpoint(programs, feature_shape, dtype, extra_args=extra_args,
-                    place=place, name=name)
+                    place=place, name=name, static_peak_bytes=static_peak_bytes)
 
 
 def estimator_endpoint(estimator, buckets: Sequence[int] = (8, 32, 128),

@@ -109,6 +109,12 @@ LOWER_IS_BETTER = {
     "dcn_bytes",
     # ISSUE 9: per-request p95 latency of the serving_qps row
     "p95_s",
+    # ISSUE 10: memcheck's static per-device peak-HBM estimate of the
+    # gated redistribution programs (ht.analysis.memcheck) — growth
+    # means a planner/executor change inflated the live set, caught
+    # pre-TPU (the xla_* cross-check fields are informational: the
+    # compiler's buffer assignment moves with XLA versions)
+    "static_peak_bytes",
 }
 
 

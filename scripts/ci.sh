@@ -133,21 +133,29 @@ python scripts/lint.py heat_tpu/
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
   python scripts/lint.py --ir-entry 8
 
-# golden-plan determinism: redistribution plans key the executor's
-# program cache, so two fresh processes must serialize the golden
-# matrix byte-identically (leg 7) — at the flat default AND at the
-# forced 2x4/2x8 two-tier topologies (ISSUE 8: tier annotations fold
-# into plan_ids, so the tiered dumps must be just as deterministic)
+# golden-plan determinism + well-formedness: redistribution plans key
+# the executor's program cache, so two fresh processes must serialize
+# the golden matrix byte-identically (leg 7) — at the flat default AND
+# at the forced 2x4/2x8 two-tier topologies (ISSUE 8: tier annotations
+# fold into plan_ids, so the tiered dumps must be just as
+# deterministic). ISSUE 10 adds the verify_plan sweep over every dumped
+# plan (flat/2x4/2x8, quant on+off — redist_plans dumps both): byte
+# identity catches nondeterminism, the verifier catches a plan that is
+# deterministically MALFORMED (broken composition/conservation/codec
+# pairing/tier labels/overlap structure/plan-id) and fails the leg with
+# the violated invariant named
 plans_a="$(mktemp)"; plans_b="$(mktemp)"
 python scripts/redist_plans.py > "$plans_a"
 python scripts/redist_plans.py > "$plans_b"
 diff "$plans_a" "$plans_b"
-echo "redist golden plans: deterministic ($(wc -l < "$plans_a") plans)"
+python scripts/verify_plans.py "$plans_a"
+echo "redist golden plans: deterministic + well-formed ($(wc -l < "$plans_a") plans)"
 for topo in 2x4 2x8; do
   python scripts/redist_plans.py --topology "$topo" > "$plans_a"
   python scripts/redist_plans.py --topology "$topo" > "$plans_b"
   diff "$plans_a" "$plans_b"
-  echo "redist golden plans @$topo: deterministic ($(wc -l < "$plans_a") plans)"
+  python scripts/verify_plans.py --topology "$topo" "$plans_a"
+  echo "redist golden plans @$topo: deterministic + well-formed ($(wc -l < "$plans_a") plans)"
 done
 rm -f "$plans_a" "$plans_b"
 

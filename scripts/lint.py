@@ -24,6 +24,14 @@ IR lint (pass 1) over the driver training step::
   train step must launch only the collectives the algorithm needs.
   Exit 1 iff an error-severity finding gates.
 
+Output formats (``--format text|json|sarif``; ``--json`` is shorthand):
+``text`` (default, one finding per line + a gate summary), ``json``
+(one JSON object per pass), ``sarif`` (ONE SARIF 2.1.0 document on
+stdout with one run per pass, rule ids = SLxxx — what CI annotation
+uploads consume; findings land on their ``file:line`` anchors). Exit
+codes are identical across formats: the gate is the findings, not the
+rendering.
+
 Rule catalog: ``heat_tpu.analysis.findings.RULES`` / docs/PERF.md
 § Static analysis. Whitelist workflow: heat_tpu/analysis/boundaries.py.
 """
@@ -38,11 +46,15 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
+_SARIF_LEVEL = {"error": "error", "warning": "warning", "info": "note"}
 
-def _print_report(report, label: str, as_json: bool) -> None:
-    if as_json:
+
+def _print_report(report, label: str, fmt: str) -> None:
+    if fmt == "json":
         print(json.dumps({"label": label, **report.as_dict()}))
         return
+    if fmt == "sarif":
+        return  # rendered once, at the end, over all passes
     for f in report.findings:
         where = f"{f.path}:{f.line}: " if f.path else ""
         print(f"{f.severity.upper():7s} {f.rule} {where}{f.message}")
@@ -56,6 +68,48 @@ def _print_report(report, label: str, as_json: bool) -> None:
     )
 
 
+def _sarif_run(report, label: str) -> dict:
+    """One SARIF run per analyzer pass: the tool is shardlint/<pass>,
+    its rules are the SLxxx catalog entries the pass fired."""
+    from heat_tpu.analysis.findings import RULES
+
+    fired = sorted({f.rule for f in report.findings})
+    results = []
+    for f in report.findings:
+        res = {
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+        }
+        if f.path:
+            region = {"startLine": int(f.line)} if f.line else {}
+            res["locations"] = [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path.replace(os.sep, "/")},
+                        **({"region": region} if region else {}),
+                    }
+                }
+            ]
+        results.append(res)
+    return {
+        "tool": {
+            "driver": {
+                "name": f"shardlint/{label}",
+                "informationUri": "docs/PERF.md",
+                "rules": [
+                    {
+                        "id": rule,
+                        "shortDescription": {"text": RULES.get(rule, rule)},
+                    }
+                    for rule in fired
+                ],
+            }
+        },
+        "results": results,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("paths", nargs="*", help="files/dirs to source-lint (pass 2)")
@@ -67,17 +121,29 @@ def main() -> int:
         help="run ht.analysis.check over the __graft_entry__ training step "
         "on an N-device mesh (pass 1)",
     )
-    ap.add_argument("--json", action="store_true", help="one JSON line per pass")
+    ap.add_argument(
+        "--format",
+        choices=("text", "json", "sarif"),
+        default=None,
+        help="output format (default text; sarif = one SARIF 2.1.0 doc, "
+        "one run per pass, for CI file annotations)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="shorthand for --format json"
+    )
     args = ap.parse_args()
+    fmt = args.format or ("json" if args.json else "text")
     if not args.paths and args.ir_entry is None:
         args.paths = [os.path.join(ROOT, "heat_tpu")]
 
     gate = False
+    reports = []
     if args.paths:
         from heat_tpu.analysis import srclint
 
         report = srclint.lint_paths(args.paths, root=ROOT)
-        _print_report(report, "srclint", args.json)
+        _print_report(report, "srclint", fmt)
+        reports.append(("srclint", report))
         gate |= not report.ok
 
     if args.ir_entry is not None:
@@ -88,8 +154,18 @@ def main() -> int:
         fn, example_args = graft.training_step_program(args.ir_entry)
         report = ht.analysis.check(fn, *example_args)
         report.context["files"] = "training_step"
-        _print_report(report, f"ircheck@{args.ir_entry}dev", args.json)
+        _print_report(report, f"ircheck@{args.ir_entry}dev", fmt)
+        reports.append((f"ircheck@{args.ir_entry}dev", report))
         gate |= not report.ok
+
+    if fmt == "sarif":
+        doc = {
+            "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json",
+            "version": "2.1.0",
+            "runs": [_sarif_run(report, label) for label, report in reports],
+        }
+        print(json.dumps(doc, indent=2))
 
     return 1 if gate else 0
 

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Sweep ``ht.analysis.verify_plan`` over dumped golden plans.
+
+The ci.sh determinism leg already proves the golden plan dumps
+(``scripts/redist_plans.py``: flat / 2x4 / 2x8, quant on+off) are
+byte-identical run-to-run; this script proves each dumped plan is
+WELL-FORMED — composition, byte conservation, codec pairing, tier
+labels, overlap structure, plan-id integrity. A malformed plan fails
+the leg with the violated invariant named::
+
+    python scripts/redist_plans.py > plans.txt
+    python scripts/verify_plans.py plans.txt
+    python scripts/redist_plans.py --topology 2x8 > plans28.txt
+    python scripts/verify_plans.py --topology 2x8 plans28.txt
+
+Input lines are ``name\\tcanonical_json`` (the dump format). With no
+file arguments the dump is read from stdin. Pure Python — no mesh, no
+jax device work — like the dump itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("files", nargs="*", help="plan dump files (default: stdin)")
+    ap.add_argument(
+        "--topology",
+        default=None,
+        help="expected topology of the dump ('flat' or 'SxC' — the value "
+        "the dump was produced with); default: self-consistency only",
+    )
+    args = ap.parse_args()
+
+    from heat_tpu.analysis.planverify import PlanVerificationError, verify_plan
+
+    streams = [open(f) for f in args.files] if args.files else [sys.stdin]
+    n = 0
+    failed = False
+    for stream in streams:
+        for line in stream:
+            line = line.strip()
+            if not line:
+                continue
+            name, _, payload = line.partition("\t")
+            if not payload:
+                print(f"verify_plans: malformed dump line {name[:60]!r}", file=sys.stderr)
+                failed = True
+                continue
+            try:
+                res = verify_plan(payload, topology=args.topology)
+            except PlanVerificationError as e:
+                print(f"FAIL  {name}: {e}")
+                failed = True
+                continue
+            n += 1
+            print(f"ok    {name}  ({res['strategy']}, plan {res['plan_id']})")
+    for stream in streams:
+        if stream is not sys.stdin:
+            stream.close()
+    if failed:
+        return 1
+    if not n:
+        print("verify_plans: no plans verified (empty input)", file=sys.stderr)
+        return 1
+    print(f"verify_plans: {n} plan(s) well-formed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

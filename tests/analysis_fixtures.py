@@ -144,6 +144,46 @@ def ppermute_ring_program(x):
     )(phys)
 
 
+def over_budget_program(x):
+    """SL301 (ISSUE 10): holds three full-size intermediates live
+    simultaneously — the liveness peak is ~4x the operand's shard, so
+    under a tiny forced budget (``memcheck(..., hbm_bytes=...)`` or
+    ``HEAT_TPU_HBM_BYTES``) the static estimate overcommits HBM and the
+    check reports SL301 at error severity BEFORE any dispatch OOMs.
+    Under the default 16 GiB budget the same program is clean — the
+    rule prices programs against the deployment target, it does not
+    punish intermediates per se."""
+    a = ht.exp(x)
+    b = ht.sqrt(ht.abs(x) + 1.0)
+    c = a * b
+    return a + b + c  # a, b, c all live at the final combine
+
+
+def dropped_donation_program(x):
+    """SL302 (ISSUE 10): the caller DONATES ``x`` (the test wraps this
+    in ``ht.jit(..., donate_argnums=0)``), but the only output is half
+    the rows — no output matches the donated aval, XLA cannot alias the
+    buffer, and the donation is silently dropped: the compiled module
+    carries no ``input_output_alias`` entry for the parameter while the
+    caller believes the HBM was reclaimed. SL105's bookkeeping alone
+    cannot see this (donation WAS declared); only the executable-level
+    check can."""
+    return ht.exp(x)[: x.shape[0] // 2]
+
+
+def replicated_liverange_program(x):
+    """SL303 (ISSUE 10): materializes a REPLICATED copy of the whole
+    operand (``resplit(None)`` — every device holds all the bytes) and
+    then keeps it live across a two-collective resplit round trip
+    before finally consuming it. The planner's peak accounting budgets
+    each exchange's transients, but the replicated value's residency
+    rides across the whole chain unseen — exactly the live-range
+    materialization memcheck's liveness analysis exists to surface."""
+    g = x.resplit(None)              # replicated materialization, held ...
+    y = x.resplit(1).resplit(0)      # ... across two collective steps
+    return g * 1.0 + y
+
+
 def serving_sync_handler(x):
     """SL106 (ISSUE 9): a serving request handler that reads device
     VALUES on the host mid-request — a debug/logging sync buried in the

@@ -254,6 +254,140 @@ class TestIRCheckCleanContracts(TestCase):
         self.assertEqual(rep.errors, [])
 
 
+class TestMemCheckGoldenFixtures(TestCase):
+    """ISSUE 10 (pass 3, memcheck): each SL3xx golden bad fixture trips
+    at its pinned severity, and the shipped contracts — TSQR, hSVD
+    level-0, the serving endpoint program, the training step — come
+    back clean under the default budget."""
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_over_budget_program_trips_sl301_under_forced_budget(self):
+        x = ht.random.randn(1 << 16, 16, split=0)  # 4 MiB operand
+        rep = ht.analysis.memcheck(fx.over_budget_program, x, hbm_bytes=1 << 20)
+        self.assertFalse(rep.ok)
+        sl301 = rep.by_rule("SL301")
+        self.assertTrue(sl301)
+        self.assertEqual(sl301[0].severity, "error")
+        self.assertGreater(sl301[0].nbytes, 1 << 20)
+        # ... and the same program under the default 16 GiB budget is clean
+        clean = ht.analysis.memcheck(fx.over_budget_program, x)
+        self.assertNotIn("SL301", clean.rule_ids)
+        self.assertEqual(
+            clean.context["hbm_budget_bytes"],
+            ht.analysis.hbm_budget_bytes(),
+        )
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_dropped_donation_trips_sl302(self):
+        """Donation declared via ht.jit bookkeeping but unusable (no
+        output aliases the donated aval) — the executable drops it, and
+        only the input_output_aliases check can see that. The honored
+        twin (full-size output) stays clean: the alias map carries the
+        donated parameter."""
+        x = ht.random.randn(64, 4096, split=0)
+        dropped = ht.analysis.memcheck(
+            ht.jit(fx.dropped_donation_program, donate_argnums=0), x
+        )
+        self.assertFalse(dropped.ok)
+        sl302 = dropped.by_rule("SL302")
+        self.assertTrue(sl302)
+        self.assertEqual(sl302[0].severity, "error")
+        self.assertIn("input_output_aliases", sl302[0].message)
+        honored = ht.analysis.memcheck(ht.jit(fx.donated_program, donate_argnums=0), x)
+        self.assertNotIn("SL302", honored.rule_ids)
+        self.assertIn(0, honored.context.get("aliased_params", []))
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_explicit_donation_on_jitted_fn_is_checked(self):
+        """The already-jitted (.lower fast path) form honors an EXPLICIT
+        donate_argnums: the donated compile is what gets alias-checked,
+        so a dropped donation reports SL302 there too — not just on the
+        ht.jit wrap path."""
+        import jax as _jax
+
+        dropped = _jax.jit(lambda a: a[:16] * 1.0)  # shardlint: ignore[SL202] -- fixture
+        x = jnp.ones((64, 4096), jnp.float32)
+        rep = ht.analysis.memcheck(dropped, x, donate_argnums=(0,))
+        self.assertIn("SL302", rep.rule_ids)
+        honored = _jax.jit(lambda a: a * 1.0)  # shardlint: ignore[SL202] -- fixture
+        clean = ht.analysis.memcheck(honored, x, donate_argnums=(0,))
+        self.assertNotIn("SL302", clean.rule_ids)
+        self.assertIn(0, clean.context.get("aliased_params", []))
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_shard_map_passthrough_keeps_caller_replication_fact(self):
+        """A shard_map whose output PASSES an input through must not
+        rewrite the caller value's replication fact in place (the body
+        invar aliases the caller's buffer record): a replicated value
+        flowing through a sharded-out passthrough stays SL303-eligible
+        for ITS OWN live range."""
+        import importlib
+
+        import jax as _jax
+        from jax.sharding import PartitionSpec as PS
+
+        from heat_tpu.core._jax_compat import shard_map
+
+        mc = importlib.import_module("heat_tpu.analysis.memcheck")
+        comm = ht.get_comm()
+        f = lambda a: shard_map(
+            lambda b: b, mesh=comm.mesh, in_specs=(PS(None, None),),
+            out_specs=PS(comm.axis_name, None), check_vma=False,
+        )(a)
+        closed = _jax.make_jaxpr(f)(jnp.ones((8, 16), jnp.float32))
+        interp = mc._Interp(comm.size)
+        in_fact = mc._Fact(8 * 16 * 4, True)
+        interp.run(closed.jaxpr, [in_fact], local_avals=False)
+        self.assertTrue(in_fact.replicated)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_replicated_liverange_trips_sl303(self):
+        x = ht.random.randn(1 << 18, 8, split=0)  # 8 MiB replicated copy
+        rep = ht.analysis.memcheck(fx.replicated_liverange_program, x)
+        sl303 = rep.by_rule("SL303")
+        self.assertTrue(sl303)
+        self.assertEqual(sl303[0].severity, "warning")
+        self.assertTrue(rep.ok)  # warning severity: reports, does not gate
+        self.assertGreaterEqual(sl303[0].nbytes, 1 << 20)
+        # the sharded twin (no replicated materialization) is clean
+        clean = ht.analysis.memcheck(lambda v: v.resplit(1).resplit(0), x)
+        self.assertNotIn("SL303", clean.rule_ids)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_shipped_contracts_memcheck_clean(self):
+        a = ht.random.randn(16 * P, 2 * P, split=0)
+        self.assertEqual(ht.analysis.memcheck(lambda v: ht.linalg.qr(v), a).rule_ids, [])
+        from heat_tpu.core.linalg.svdtools import _local_svd_fn
+
+        comm = ht.get_comm()
+        phys = comm.shard(jnp.ones((16, 4 * P), jnp.float32), 1)
+        fn = _local_svd_fn(comm.mesh, comm.axis_name, 16, phys.shape[1] // P, 3, "float32", 5)
+        self.assertEqual(ht.analysis.memcheck(fn, phys).rule_ids, [])
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_training_step_memcheck_clean(self):
+        import __graft_entry__ as graft
+
+        fn, args = graft.training_step_program(P)
+        rep = ht.analysis.memcheck(fn, *args)
+        self.assertEqual(rep.rule_ids, [])
+        self.assertGreater(rep.context["static_peak_bytes"], 0)
+
+    def test_serving_endpoint_program_memcheck_clean(self):
+        from heat_tpu.cluster import _kcluster
+
+        centers = jnp.linspace(0.0, 1.0, 5 * 12, dtype=jnp.float32).reshape(5, 12)
+        spec = _kcluster.serving_spec("euclidean", centers)
+        prog = spec["build"]()
+        batch = jnp.zeros((8, 12), jnp.float32)
+        rep = ht.analysis.memcheck(prog, batch, *spec["args"])
+        self.assertEqual(rep.rule_ids, [])
+
+    def test_sl3xx_rules_are_cataloged(self):
+        for rule in ("SL301", "SL302", "SL303"):
+            self.assertIn(rule, findings.RULES)
+
+
 class TestSrcLint(TestCase):
     def test_shipped_tree_is_clean(self):
         rep = srclint.lint_paths([os.path.join(ROOT, "heat_tpu")], root=ROOT)
@@ -344,6 +478,50 @@ class TestLintCLI(TestCase):
             self.assertEqual(r.returncode, 1, r.stdout + r.stderr)
             self.assertIn("SL201", r.stdout)
             self.assertIn("SL202", r.stdout)
+
+    def test_sarif_format_exit_codes(self):
+        """ISSUE 10 satellite: `--format sarif` emits one SARIF 2.1.0
+        document with one run per pass and rule ids = SLxxx, while the
+        exit-code contract is unchanged — 0 on the clean tree, 1 on a
+        seeded violation (the gate is the findings, not the format)."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        ok = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "lint.py"),
+             "--format", "sarif", os.path.join(ROOT, "heat_tpu")],
+            capture_output=True, text=True, env=env,
+        )
+        self.assertEqual(ok.returncode, 0, ok.stdout + ok.stderr)
+        doc = json.loads(ok.stdout)
+        self.assertEqual(doc["version"], "2.1.0")
+        self.assertEqual(len(doc["runs"]), 1)  # one run per pass
+        self.assertEqual(doc["runs"][0]["tool"]["driver"]["name"], "shardlint/srclint")
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            bad = os.path.join(td, "seeded.py")
+            with open(bad, "w") as f:
+                f.write("import jax\ndef op(x):\n    return jax.jit(lambda v: v)(jax.device_get(x))\n")
+            r = subprocess.run(
+                [sys.executable, os.path.join(ROOT, "scripts", "lint.py"),
+                 "--format", "sarif", bad],
+                capture_output=True, text=True, env=env,
+            )
+            self.assertEqual(r.returncode, 1, r.stdout + r.stderr)
+            doc = json.loads(r.stdout)
+            results = doc["runs"][0]["results"]
+            rules = {res["ruleId"] for res in results}
+            self.assertIn("SL201", rules)
+            self.assertIn("SL202", rules)
+            self.assertTrue(all(res["level"] in ("error", "warning", "note") for res in results))
+            # findings anchor on file:line for CI annotation
+            loc = results[0]["locations"][0]["physicalLocation"]
+            self.assertTrue(loc["artifactLocation"]["uri"].endswith("seeded.py"))
+            self.assertGreaterEqual(loc["region"]["startLine"], 1)
+            # declared rules carry the catalog text
+            driver = doc["runs"][0]["tool"]["driver"]
+            self.assertTrue(
+                all(rule["id"] in findings.RULES for rule in driver["rules"])
+            )
 
 
 class TestBoundaries(TestCase):

@@ -594,3 +594,42 @@ class TestAdmission(TestCase):
         self.assertEqual(shed.reason, "deadline")
         with self.assertRaises(ValueError):
             AdmissionControl(max_queue=0)
+
+    def test_memory_policy_units(self):
+        """ISSUE 10: the hbm-estimate admission arm. No declared
+        estimate never rejects (the pre-memcheck code path); an
+        estimate over the budget rejects typed with the budget as the
+        limit and the estimate attached."""
+        ac = AdmissionControl(max_queue=3, hbm_limit_bytes=1 << 20)
+        self.assertFalse(ac.over_memory(None))
+        self.assertFalse(ac.over_memory(1 << 20))  # at the limit: fits
+        self.assertTrue(ac.over_memory((1 << 20) + 1))
+        exc = ac.reject_memory(5 << 20)
+        self.assertEqual(exc.reason, "hbm-estimate")
+        self.assertEqual(exc.limit, 1 << 20)
+        self.assertEqual(exc.static_peak_bytes, 5 << 20)
+        self.assertIn("hbm-estimate", str(exc))
+        # default limit resolves HEAT_TPU_HBM_BYTES (16 GiB unset):
+        # sane programs always fit
+        self.assertFalse(AdmissionControl().over_memory(1 << 30))
+
+    def test_dispatcher_rejects_over_budget_endpoint(self):
+        """An endpoint that declares a static peak over the admission
+        budget is rejected at submit — typed, before any dispatch can
+        OOM; the same endpoint with no declared estimate serves."""
+        ep = Endpoint(
+            {4: lambda b: b * 2.0}, (3,), np.float32,
+            static_peak_bytes=2 << 20,
+        )
+        ac = AdmissionControl(max_queue=4, hbm_limit_bytes=1 << 20)
+        with Dispatcher(ep, admission=ac) as d:
+            with self.assertRaises(ServingOverloaded) as cm:
+                d.submit(np.zeros((2, 3), np.float32))
+            self.assertEqual(cm.exception.reason, "hbm-estimate")
+            self.assertEqual(cm.exception.static_peak_bytes, 2 << 20)
+            self.assertGreaterEqual(d.stats()["rejected"], 1)
+        ep_fits = Endpoint({4: lambda b: b * 2.0}, (3,), np.float32)
+        with Dispatcher(ep_fits, admission=AdmissionControl(
+                max_queue=4, hbm_limit_bytes=1 << 20)) as d:
+            out = np.asarray(d.call(np.ones((2, 3), np.float32), timeout=30))
+        np.testing.assert_allclose(out, 2.0)
