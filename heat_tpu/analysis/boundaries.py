@@ -87,6 +87,10 @@ DATA_DEPENDENT_BOUNDARIES: Dict[Tuple[str, str], str] = {
     ("core/parallel.py", "distributed_unique"): (
         "the merged-unique total sizes the result; shape is data"
     ),
+    ("core/parallel.py", "distributed_unique_rows"): (
+        "the merged rows-unique total sizes the result; shape is data "
+        "(the axis-mode twin of distributed_unique — ISSUE 11 satellite)"
+    ),
     ("core/linalg/svdtools.py", "_hsvd_impl"): (
         "adaptive-rank hSVD reads the singular values to choose the rank "
         "the next merge level keeps — the rank IS data-dependent output "

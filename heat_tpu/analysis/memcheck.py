@@ -67,10 +67,14 @@ from .findings import AnalysisReport, Finding
 
 __all__ = ["DEFAULT_HBM_BYTES", "HBM_ENV", "hbm_budget_bytes", "memcheck"]
 
+from ..core import tiers as _tiers
+
 #: per-device HBM of the deployment target (v5e: 16 GiB) — the SL301
-#: budget when ``HEAT_TPU_HBM_BYTES`` is unset.
-DEFAULT_HBM_BYTES = 16 << 30
-HBM_ENV = "HEAT_TPU_HBM_BYTES"
+#: budget when ``HEAT_TPU_HBM_BYTES`` is unset. Since ISSUE 11 the
+#: number is the ``hbm`` tier's capacity in the one memory-tier cost
+#: lattice (``core.tiers``); aliased here for the established imports.
+DEFAULT_HBM_BYTES = _tiers.DEFAULT_HBM_BYTES
+HBM_ENV = _tiers.HBM_ENV
 
 #: jaxpr primitives that launch a collective — the "steps" rule SL303
 #: counts a replicated live range across.
@@ -91,13 +95,10 @@ _CALL_PARAM_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr", "fwd_jaxpr_thunk")
 
 def hbm_budget_bytes() -> int:
     """Per-device HBM budget for rule SL301 (``HEAT_TPU_HBM_BYTES``,
-    default 16 GiB — the v5e chip)."""
-    raw = os.environ.get(HBM_ENV, "")
-    try:
-        b = int(raw) if raw.strip() else DEFAULT_HBM_BYTES
-    except ValueError:
-        b = DEFAULT_HBM_BYTES
-    return max(1, b)
+    default 16 GiB — the v5e chip): ``tiers.capacity("hbm")``, the hbm
+    tier's capacity in the memory-tier lattice. One number shared with
+    serving admission and the out-of-core staging slab ceiling."""
+    return _tiers.capacity("hbm")
 
 
 def _aval_bytes(aval) -> int:

@@ -45,6 +45,14 @@ well-formed, invariant by invariant:
     right number of collective laps, and the depth-2 critical-path
     arithmetic (``w + (laps-1)·max(w, c) + c``; the tiered
     ``max(ici, dcn·penalty, copy)`` form) reproduces the annotation.
+``staging``
+    out-of-core window schedules (ISSUE 11, ``host-staging`` plans):
+    every ``stage_in`` pairs with its ``stage_out`` on writeback
+    passes, each pass's windows conserve the operand exactly, the
+    recorded depth-2 slab occupancies match the window+prefetch
+    recompute, the resident working set plus the slab peak fits
+    ``tiers.capacity("hbm")``, and the annotation's lattice time model
+    (``tiers.transfer_time`` over the pcie/hbm edges) is reproduced.
 ``plan-id``
     the ``plan_id`` is the sha1 of the canonical serialization — a
     hand-edited or bit-rotted dump cannot keep its id.
@@ -69,6 +77,10 @@ _LOCAL_KINDS = (
     "quantize", "dequantize",
 )
 _CODEC_KINDS = ("quantize", "dequantize")
+# ISSUE 11: the out-of-core staging transfers (redistribution.staging)
+# — they move bytes across the pcie edge of the memory-tier lattice but
+# launch no collective, so they sit in neither class above
+_STAGING_KINDS = ("stage_in", "stage_out")
 
 
 class PlanVerificationError(ValueError):
@@ -78,8 +90,8 @@ class PlanVerificationError(ValueError):
     ----------
     invariant : the violated invariant's name (``composition``,
         ``conservation``, ``accounting``, ``quant-pairing``,
-        ``tier-labels``, ``overlap-structure``, ``plan-id``,
-        ``step-kinds``).
+        ``tier-labels``, ``overlap-structure``, ``staging``,
+        ``plan-id``, ``step-kinds``).
     detail : what exactly failed, with the offending numbers.
     plan_id : the plan's id when known.
     """
@@ -213,10 +225,26 @@ def verify_plan(
     # ---- step-kinds: the vocabulary itself ----------------------------
     for k, st in enumerate(steps):
         kind = st.get("kind")
-        if kind not in _COLLECTIVE_KINDS and kind not in _LOCAL_KINDS:
+        if (
+            kind not in _COLLECTIVE_KINDS
+            and kind not in _LOCAL_KINDS
+            and kind not in _STAGING_KINDS
+        ):
             fail("step-kinds", f"step [{k}] has unknown kind {kind!r}")
-        if st.get("tier") not in (None, "ici", "dcn"):
+        if st.get("tier") not in (None, "ici", "dcn", "pcie"):
             fail("step-kinds", f"step [{k}] has unknown tier {st.get('tier')!r}")
+        if kind in _STAGING_KINDS and st.get("tier") != "pcie":
+            fail(
+                "step-kinds",
+                f"staging step [{k}] ({kind}) must ride tier 'pcie' — got "
+                f"{st.get('tier')!r}",
+            )
+        if kind not in _STAGING_KINDS and st.get("tier") == "pcie":
+            fail(
+                "step-kinds",
+                f"step [{k}] ({kind}) claims tier 'pcie' — reserved for "
+                "stage_in/stage_out",
+            )
         for field in ("bytes_moved", "bytes_copied", "peak_bytes"):
             if int(st.get(field, 0)) < 0:
                 fail("step-kinds", f"step [{k}] has negative {field}")
@@ -224,7 +252,8 @@ def verify_plan(
             fail(
                 "step-kinds",
                 f"local step [{k}] ({kind}) claims bytes_moved="
-                f"{st['bytes_moved']} — only collectives move bytes",
+                f"{st['bytes_moved']} — only collectives and staging "
+                "transfers move bytes",
             )
 
     coll = [st for st in steps if st.get("kind") in _COLLECTIVE_KINDS]
@@ -406,7 +435,11 @@ def verify_plan(
                 f"— got tiers {tiers}",
             )
     for k, st in enumerate(steps):
-        if st.get("kind") not in _COLLECTIVE_KINDS and st.get("tier") is not None:
+        if (
+            st.get("kind") not in _COLLECTIVE_KINDS
+            and st.get("kind") not in _STAGING_KINDS
+            and st.get("tier") is not None
+        ):
             fail("tier-labels", f"local step [{k}] ({st['kind']}) carries a tier")
 
     # ---- composition: src must compose to dst -------------------------
@@ -497,6 +530,23 @@ def verify_plan(
                     f"hierarchical laps come in intra/inter pairs — got "
                     f"{len(coll_kinds)} collectives"
                 )
+        elif strategy == "host-staging":
+            # ISSUE 11: the out-of-core window stream — no mesh
+            # movement at all, only pcie staging transfers
+            if coll_kinds:
+                return f"host-staging launches no collectives — got {coll_kinds}"
+            if not kinds or any(k not in _STAGING_KINDS for k in kinds):
+                return (
+                    "host-staging steps are stage_in/stage_out windows only "
+                    f"— got {sorted(set(kinds) - set(_STAGING_KINDS))}"
+                )
+            if d.get("staging") is None:
+                return "host-staging requires a staging annotation"
+            if src is not None or dst is not None:
+                return (
+                    "host-staging streams a host-resident operand — splits "
+                    f"must be None (src={src}, dst={dst})"
+                )
         else:
             return f"unknown strategy {strategy!r}"
         return None
@@ -511,6 +561,15 @@ def verify_plan(
     def _expected_raw() -> Optional[int]:
         if strategy in ("noop", "local", "slice", "local-reshape"):
             return 0
+        if strategy == "host-staging":
+            # every pass streams the whole operand across pcie once
+            # (twice with writeback) — the window partition must
+            # conserve it exactly
+            sg = d.get("staging") or {}
+            return sum(
+                size * item * (2 if pm.get("writeback") else 1)
+                for pm in (sg.get("passes") or [])
+            )
         if strategy in ("replicate", "gather-reshape"):
             return size * item * (p - 1) // p
         if strategy in ("all-to-all", "chunked-all-to-all") or (
@@ -657,6 +716,176 @@ def verify_plan(
                         "such groups",
                     )
 
+    # ---- staging: the out-of-core window schedule (ISSUE 11) ----------
+    staging = d.get("staging")
+    stage_steps = [st for st in steps if st.get("kind") in _STAGING_KINDS]
+    if stage_steps and not staging:
+        fail(
+            "staging",
+            f"{len(stage_steps)} stage_in/stage_out step(s) but no "
+            "schedule-level staging annotation",
+        )
+    if staging:
+        if not stage_steps:
+            fail("staging", "staging annotation present but no staging step")
+        if int(staging.get("depth", 0)) != 2:
+            fail("staging", f"unsupported staging depth {staging.get('depth')}")
+        if int(staging.get("host_bytes", -1)) != size * item:
+            fail(
+                "staging",
+                f"annotation host_bytes={staging.get('host_bytes')} != the "
+                f"operand's {size * item} B",
+            )
+        if int(staging.get("slab_bytes", -1)) != budget:
+            fail(
+                "staging",
+                f"annotation slab_bytes={staging.get('slab_bytes')} != the "
+                f"schedule budget {budget} (the slab IS the staged budget)",
+            )
+        passes = list(staging.get("passes") or [])
+        if not passes:
+            fail("staging", "staging annotation with no passes")
+        idx = 0
+        max_window = 0
+        pcie_total = 0
+        for pm in passes:
+            tag, n = pm.get("tag"), int(pm.get("n_windows", 0))
+            wb = bool(pm.get("writeback"))
+            per = 2 if wb else 1
+            seg = stage_steps[idx : idx + n * per]
+            idx += n * per
+            if len(seg) != n * per:
+                fail(
+                    "staging",
+                    f"pass {tag!r} declares {n} window(s) "
+                    f"({'with' if wb else 'no'} writeback) but the step list "
+                    "ran out — stage-in/stage-out pairing is broken",
+                )
+                break
+            win_bytes: List[int] = []
+            for k in range(n):
+                si = seg[per * k]
+                if si.get("kind") != "stage_in":
+                    fail(
+                        "staging",
+                        f"pass {tag!r} window {k}: expected stage_in, got "
+                        f"{si.get('kind')}",
+                    )
+                if wb:
+                    so = seg[per * k + 1]
+                    if so.get("kind") != "stage_out":
+                        fail(
+                            "staging",
+                            f"pass {tag!r} window {k}: writeback pass must "
+                            f"pair stage_in with stage_out, got {so.get('kind')}",
+                        )
+                    elif int(so.get("bytes_moved", -1)) != int(si.get("bytes_moved", 0)):
+                        fail(
+                            "staging",
+                            f"pass {tag!r} window {k}: stage_out ships "
+                            f"{so.get('bytes_moved')} B != the window's "
+                            f"{si.get('bytes_moved')} B stage_in",
+                        )
+                win_bytes.append(int(si.get("bytes_moved", 0)))
+            if sum(win_bytes) != size * item:
+                fail(
+                    "staging",
+                    f"pass {tag!r} windows sum to {sum(win_bytes)} B != the "
+                    f"operand's {size * item} B — window conservation broken",
+                )
+            if win_bytes and max(win_bytes) != int(pm.get("window_bytes", -1)):
+                fail(
+                    "staging",
+                    f"pass {tag!r} annotation window_bytes="
+                    f"{pm.get('window_bytes')} != max window {max(win_bytes)}",
+                )
+            if int(pm.get("pcie_bytes", -1)) != sum(win_bytes) * per:
+                fail(
+                    "staging",
+                    f"pass {tag!r} annotation pcie_bytes={pm.get('pcie_bytes')} "
+                    f"!= streamed total {sum(win_bytes) * per}",
+                )
+            # depth-2 slab occupancy: window k's transient is its own
+            # bytes plus the prefetched window k+1
+            for k in range(n):
+                occ = win_bytes[k] + (win_bytes[k + 1] if k + 1 < n else 0)
+                for st in seg[per * k : per * k + per]:
+                    if int(st.get("peak_bytes", -1)) != occ:
+                        fail(
+                            "staging",
+                            f"pass {tag!r} window {k}: recorded slab occupancy "
+                            f"{st.get('peak_bytes')} B != depth-2 recompute "
+                            f"{occ} B (this window + the prefetched next)",
+                        )
+            max_window = max(max_window, max(win_bytes or [0]))
+            pcie_total += sum(win_bytes) * per
+        if idx != len(stage_steps):
+            fail(
+                "staging",
+                f"{len(stage_steps) - idx} staging step(s) not covered by "
+                "any declared pass",
+            )
+        if int(staging.get("n_windows", -1)) != sum(
+            int(pm.get("n_windows", 0)) for pm in passes
+        ):
+            fail(
+                "staging",
+                f"annotation n_windows={staging.get('n_windows')} != pass sum "
+                f"{sum(int(pm.get('n_windows', 0)) for pm in passes)}",
+            )
+        if int(staging.get("window_bytes", -1)) != max_window:
+            fail(
+                "staging",
+                f"annotation window_bytes={staging.get('window_bytes')} != "
+                f"max window {max_window}",
+            )
+        # the slab peak must fit the hbm tier next to the resident
+        # working set. The budget checked is the one RECORDED in the
+        # annotation (the capacity the plan was sized against), so a
+        # dumped plan's well-formedness is environment-independent —
+        # `staging.prove_fits` re-checks the AMBIENT capacity at
+        # execution time, where the current chip is what matters.
+        from ..core import tiers as _tiers_mod
+
+        resident = int(staging.get("resident_bytes", 0))
+        if resident < 0:
+            fail("staging", f"negative resident_bytes {resident}")
+        hbm_cap = int(
+            staging.get("hbm_capacity_bytes", _tiers_mod.capacity("hbm"))
+        )
+        if hbm_cap < 1:
+            fail("staging", f"annotation hbm_capacity_bytes={hbm_cap} is not positive")
+        if resident + recomputed_peak > hbm_cap:
+            fail(
+                "staging",
+                f"staged working set {resident} B + slab peak "
+                f"{recomputed_peak} B exceeds the recorded hbm capacity "
+                f"{hbm_cap} B — the window schedule does not fit the chip "
+                "it was sized for",
+            )
+        model = staging.get("model") or {}
+        want_pcie_s = round(pcie_total / _tiers_mod.PCIE_BPS, 9)
+        want_hbm_s = round(pcie_total / _tiers_mod.HBM_BPS, 9)
+        n_total = sum(int(pm.get("n_windows", 0)) for pm in passes)
+        seq_s = want_pcie_s + want_hbm_s
+        cp_s = max(want_pcie_s, want_hbm_s) + min(want_pcie_s, want_hbm_s) / max(
+            n_total, 1
+        )
+        for field, want in (
+            ("pcie_s", want_pcie_s),
+            ("hbm_s", want_hbm_s),
+            ("sequential_s", round(seq_s, 9)),
+            ("critical_path_s", round(cp_s, 9)),
+            ("model_speedup", round(seq_s / cp_s, 4) if cp_s else 1.0),
+            ("bound_gbps", round(pcie_total / cp_s / 1e9, 3) if cp_s else 0.0),
+        ):
+            if abs(float(model.get(field, -1)) - want) > 1e-6:
+                fail(
+                    "staging",
+                    f"model {field}={model.get(field)} != the lattice "
+                    f"recompute {want} (tiers.transfer_time arithmetic)",
+                )
+
     # ---- plan-id: the sha1 of the canonical serialization -------------
     if plan_id is not None:
         stripped = {k: v for k, v in d.items() if k != "plan_id"}
@@ -671,7 +900,8 @@ def verify_plan(
 
     checks = [
         "step-kinds", "accounting", "quant-pairing", "tier-labels",
-        "composition", "conservation", "overlap-structure", "plan-id",
+        "composition", "conservation", "overlap-structure", "staging",
+        "plan-id",
     ]
     return {
         "ok": not violations,

@@ -7,11 +7,20 @@ ONE jit-compiled program: the distance matrix rides the MXU (quadratic
 expansion), the per-cluster sums are a single one-hot matmul whose
 reduction over the sharded sample axis lowers to ONE all-reduce of a
 (k × d+1) buffer — independent of k — and convergence is a scalar.
+
+ISSUE 11 adds the STREAMING form: ``partial_fit`` (sklearn
+MiniBatchKMeans-style running-mean updates, one fused program per
+batch) and, through it, fits over HOST-RESIDENT operands — a
+``ht.redistribution.staging.HostArray`` larger than HBM streams
+(8,128)-aligned windows through the depth-2 double-buffered staging
+slab, each window one ``partial_fit`` batch.
 """
 
 from __future__ import annotations
 
 import functools
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +29,7 @@ from typing import Optional, Union
 
 from ..core import types
 from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
 from ._kcluster import _KCluster
 from ..core.communication import place as _place
 
@@ -78,6 +88,47 @@ def _lloyd_step(k: int, shape, jdtype: str, use_pallas: Optional[bool] = None):
     return step
 
 
+@functools.lru_cache(maxsize=64)
+def _partial_fit_step(k: int, shape, jdtype: str):
+    """One STREAMING minibatch update as a pure jitted function:
+    ``(arr, centers, counts) -> (new_centers, new_counts, inertia)``.
+
+    The standard running-mean update (sklearn MiniBatchKMeans with
+    per-center counts): every center is the mean of ALL samples ever
+    assigned to it, so one epoch over a stream of disjoint batches
+    touches each sample once — the pass-structured form the out-of-core
+    staging executor feeds window by window. Same program shape as the
+    Lloyd step: distances on the MXU, the per-cluster sums ONE one-hot
+    matmul (a single all-reduce on a sharded batch), inertia a scalar.
+    """
+
+    @jax.jit
+    def step(arr, centers, counts):
+        x2 = jnp.sum(arr * arr, axis=1, keepdims=True)
+        c2 = jnp.sum(centers * centers, axis=1, keepdims=True).T
+        d2 = jnp.maximum(x2 + c2 - 2.0 * (arr @ centers.T), 0.0)
+        labels = jnp.argmin(d2, axis=1)
+        onehot = jax.nn.one_hot(labels, k, dtype=arr.dtype)  # (n, k)
+        sums = onehot.T @ arr  # (k, d) — one all-reduce over the mesh
+        # counts accumulate in f32 REGARDLESS of the data dtype: a bf16
+        # running count saturates at 256 and the stream silently
+        # overweights late batches (f32 additions are exact to 16M)
+        bcounts = jnp.sum(onehot.astype(jnp.float32), axis=0)  # (k,)
+        new_counts = counts + bcounts
+        # running mean: n_c·c + Σ_batch, renormalized by the new count —
+        # the mix runs in f32 (exact no-op for f32 data)
+        new_centers = jnp.where(
+            new_counts[:, None] > 0,
+            (centers.astype(jnp.float32) * counts[:, None] + sums.astype(jnp.float32))
+            / jnp.maximum(new_counts[:, None], 1),
+            centers.astype(jnp.float32),
+        ).astype(arr.dtype)
+        inertia = jnp.sum(jnp.min(d2, axis=1))
+        return new_centers, new_counts, inertia
+
+    return step
+
+
 class KMeans(_KCluster):
     """K-Means with Lloyd's algorithm (reference: kmeans.py:17).
 
@@ -104,6 +155,9 @@ class KMeans(_KCluster):
             tol=tol,
             random_state=random_state,
         )
+        # streaming state (partial_fit): samples-per-center running
+        # counts — None until the first batch initializes the centers
+        self._partial_counts = None
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
         """Masked-mean centroid update (reference: kmeans.py:74-100) —
@@ -126,9 +180,110 @@ class KMeans(_KCluster):
             x.comm,
         )
 
-    def fit(self, x: DNDarray) -> "KMeans":
+    def fit(self, x) -> "KMeans":
         """Run Lloyd iterations to convergence (reference: kmeans.py:102).
         Seeding, the convergence while_loop and the final assignment run
         as ONE compiled program — a single dispatch per fit (see
-        ``_kcluster._fused_fit_program``)."""
+        ``_kcluster._fused_fit_program``).
+
+        ``x`` may be a ``ht.redistribution.staging.HostArray`` (ISSUE
+        11): the fit then STREAMS the host-resident operand — one epoch
+        of :meth:`partial_fit` windows through the staging slab (the
+        documented streaming-k-means algorithm; ``labels_`` stays unset
+        — call :meth:`predict` batch-wise). With ``HEAT_TPU_OOC=0`` a
+        fitting host operand materializes whole and runs the exact
+        in-HBM Lloyd fit instead."""
+        from ..redistribution import staging as _staging
+
+        if isinstance(x, _staging.HostArray):
+            if not _staging.ooc_engaged(x.nbytes, host_resident=True):
+                return self._fit_fused(
+                    _staging.materialize(x, what="KMeans.fit"),
+                    _lloyd_step,
+                    returns_inertia=True,
+                )
+            # fit() is a FRESH fit: drop any previous streaming state
+            # (partial_fit is the API that continues a stream)
+            self._cluster_centers = None
+            self._partial_counts = None
+            return self._partial_fit_stream(x)
         return self._fit_fused(x, _lloyd_step, returns_inertia=True)
+
+    # ------------------------------------------------------------------ #
+    # streaming / out-of-core (ISSUE 11)                                 #
+    # ------------------------------------------------------------------ #
+    def partial_fit(self, x) -> "KMeans":
+        """Incremental fit on ONE batch (sklearn MiniBatchKMeans-style;
+        no reference analog): the first call initializes the centers
+        from the batch with the configured ``init``, every call folds
+        the batch into the per-center running means — one fused program
+        dispatch per batch (``_partial_fit_step``). A
+        ``staging.HostArray`` batch streams its windows through the
+        staging executor, each window one update (with
+        ``HEAT_TPU_OOC=0`` it materializes whole — one update — when it
+        fits). ``inertia_`` reports the LAST batch's functional value."""
+        from ..redistribution import staging as _staging
+
+        if isinstance(x, _staging.HostArray):
+            if not _staging.ooc_engaged(x.nbytes, host_resident=True):
+                return self._partial_fit_batch(
+                    _staging.materialize(x, what="KMeans.partial_fit")
+                )
+            return self._partial_fit_stream(x)
+        return self._partial_fit_batch(x)
+
+    def _partial_fit_batch(self, x: DNDarray) -> "KMeans":
+        sanitize_in(x)
+        if x.ndim != 2:
+            raise ValueError(f"input needs to be 2-dimensional, got {x.ndim}")
+        k = self.n_clusters
+        arr = x.larray
+        if types.heat_type_is_exact(x.dtype):
+            arr = arr.astype(jnp.float32)
+        if self._cluster_centers is None:
+            self._initialize_cluster_centers(x)
+        if self._partial_counts is None:
+            # fresh stream — also the partial_fit-after-fit() case, which
+            # continues refining the FITTED centers from count zero
+            # (sklearn MiniBatchKMeans.partial_fit semantics)
+            self._partial_counts = jnp.zeros((k,), dtype=jnp.float32)
+        centers = self._cluster_centers.larray.astype(arr.dtype)
+        step = _partial_fit_step(k, tuple(arr.shape), np.dtype(arr.dtype).name)
+        centers, self._partial_counts, self._inertia = step(
+            arr, centers, self._partial_counts
+        )
+        self._cluster_centers = DNDarray(
+            _place(centers, x.comm.sharding(2, None)),
+            (k, x.shape[1]),
+            types.canonical_heat_type(centers.dtype),
+            None,
+            x.device,
+            x.comm,
+        )
+        return self
+
+    def _partial_fit_stream(self, host) -> "KMeans":
+        """One epoch of ``partial_fit`` windows over a host-resident
+        operand: the window schedule is planned as a ``host-staging``
+        Schedule (axis-0 windows), PROVEN to fit ``capacity("hbm")``,
+        and executed depth-2 double-buffered — window k+1's
+        ``device_put`` rides under window k's fused update."""
+        from ..core import factories
+        from ..redistribution import staging as _staging
+
+        sched = _staging.plan_staged_passes(
+            host.shape,
+            host.dtype,
+            [{"tag": "partial-fit", "axis": 0}],
+            out_bytes=self.n_clusters * host.shape[1] * 8 + (1 << 20),
+        )
+        _staging.prove_fits(sched)
+        wins = _staging.window_extents(
+            host.shape, host.dtype.itemsize, 0, int(sched.staging["slab_bytes"])
+        )
+
+        def consume(k, slab_arr, win):
+            self._partial_fit_batch(factories.array(slab_arr, split=None))
+
+        _staging.stream_windows(host, 0, wins, consume)
+        return self

@@ -31,6 +31,7 @@ from .tiling import *
 from .trigonometrics import *
 
 from . import random
+from . import tiers
 from . import tiling
 
 from . import linalg

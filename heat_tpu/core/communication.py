@@ -66,21 +66,25 @@ __all__ = [
 # --------------------------------------------------------------------- #
 # two-tier topology (ISSUE 8)                                           #
 # --------------------------------------------------------------------- #
+from . import tiers as _tiers
+
 #: per-chip bidirectional ICI bandwidth (v5e, docs/PERF.md multi-chip
 #: analytic model) — the intra-slice tier every earlier PR priced.
-ICI_BPS = 200e9
+#: Since ISSUE 11 the number lives in the one memory-tier cost lattice
+#: (``core.tiers``); re-exported here for the established import sites.
+ICI_BPS = _tiers.ICI_BPS
 
 #: per-chip DCN bandwidth across slices (~8x slower than ICI): the
 #: inter-slice tier multi-slice deployments add. No DCN hardware is
 #: attached to this container — the constant feeds the same analytic
 #: model + HLO-census methodology the multichip work is pinned with.
-DCN_BPS = 25e9
+DCN_BPS = _tiers.DCN_BPS
 
 #: cost-model penalty of a DCN-tier byte relative to an ICI-tier byte
-#: (= ICI_BPS / DCN_BPS). The redistribution planner prices tier="dcn"
-#: collective steps with this multiplier so the byte-equivalent cost
-#: scalar keeps one unit.
-DCN_PENALTY = int(ICI_BPS / DCN_BPS)
+#: (= ICI_BPS / DCN_BPS = ``tiers.penalty("dcn")``). The redistribution
+#: planner prices tier="dcn" collective steps with this multiplier so
+#: the byte-equivalent cost scalar keeps one unit.
+DCN_PENALTY = _tiers.penalty("dcn")
 
 #: ``HEAT_TPU_TOPOLOGY``: ``auto`` (default — read ``slice_index`` off
 #: the resolved world's devices; single-slice and CPU worlds stay flat),
@@ -150,8 +154,11 @@ class Topology:
         return [[s * C + c for s in range(self.n_slices)] for c in range(C)]
 
     def bandwidth(self, tier: str) -> float:
-        """Per-chip bytes/s of ``tier`` (``"ici"``/``"dcn"``)."""
-        return {"ici": ICI_BPS, "dcn": DCN_BPS}[tier]
+        """Per-chip bytes/s of ``tier`` (``"ici"``/``"dcn"``) — the
+        lattice edge price (``core.tiers.bandwidth``)."""
+        if tier not in ("ici", "dcn"):
+            raise KeyError(tier)
+        return _tiers.bandwidth(tier)
 
     @classmethod
     def parse(cls, text: str) -> Optional["Topology"]:

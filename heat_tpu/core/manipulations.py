@@ -777,19 +777,128 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
     return vals, idx
 
 
+def _lex_searchsorted_rows(sorted_rows, queries):
+    """Index of each query ROW in a lexicographically sorted row set
+    (every query must be present): a vectorized lower-bound binary
+    search — ``log2(nu)`` steps of O(n·R) work, the rows edition of the
+    flat path's ``searchsorted``. The naive pairwise-equality tensor
+    would be O(n·nu·R) — an OOM in exactly the large-operand regime
+    this subsystem targets. Comparison runs on the SORTABLE-uint bit
+    view, so unsigned order is value order."""
+    nu = int(sorted_rows.shape[0])
+    n = queries.shape[0]
+    lo = jnp.zeros((n,), dtype=jnp.int32)
+    hi = jnp.full((n,), nu, dtype=jnp.int32)
+
+    def body(_, state):
+        lo, hi = state
+        mid = (lo + hi) // 2
+        pivot = jnp.take(sorted_rows, jnp.minimum(mid, nu - 1), axis=0)  # (n, R)
+        diff = pivot != queries
+        has = jnp.any(diff, axis=1)
+        first = jnp.argmax(diff, axis=1)
+        pv = jnp.take_along_axis(pivot, first[:, None], axis=1)[:, 0]
+        qv = jnp.take_along_axis(queries, first[:, None], axis=1)[:, 0]
+        lt = has & (pv < qv)  # pivot <lex query
+        searching = lo < hi
+        lo = jnp.where(searching & lt, mid + 1, lo)
+        hi = jnp.where(searching & ~lt, mid, hi)
+        return lo, hi
+
+    steps = max(nu.bit_length(), 1)
+    lo, _ = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
+
+
+def _unique_axis_distributed(a: DNDarray, axis: int, return_inverse: bool):
+    """Gather-free distributed ``unique(axis=)`` — the sorted-split
+    rows formulation (``parallel.distributed_unique_rows``): move the
+    requested axis to the front, resplit to rows, bit-view each slice
+    through the ``kernels.sort`` monotone transform, and run per-shard
+    lexicographic sorted-unique + candidate-prefix merge. Only the
+    small candidate set is ever gathered. Returns ``NotImplemented``
+    when the formulation cannot serve (untransformable dtype, slices
+    wider than 256 elements) — the caller falls back to the eager path."""
+    from . import parallel as _parallel
+    from ..kernels import sort as _ksort
+
+    rest = tuple(s for i, s in enumerate(a.gshape) if i != axis)
+    R = 1
+    for s in rest:
+        R *= int(s)
+    if R == 0 or R > 256:
+        return NotImplemented
+    arr = a if axis == 0 else moveaxis(a, axis, 0)
+    if arr.split != 0:
+        arr = arr.resplit(0)
+    phys = arr._phys
+    is_bool = phys.dtype == jnp.bool_
+    if is_bool:
+        phys = phys.astype(jnp.uint8)
+    if not _ksort.transformable(phys.dtype):
+        return NotImplemented
+    n = int(arr.gshape[0])
+    u = _ksort.to_sortable(phys.reshape(phys.shape[0], R))  # local flatten
+    merged_u = _parallel.distributed_unique_rows(
+        u, n, arr.comm.mesh, arr.comm.axis_name
+    )
+    vals_flat = _ksort.from_sortable(merged_u, phys.dtype)
+    if is_bool:
+        vals_flat = vals_flat.astype(jnp.bool_)
+    nu = int(vals_flat.shape[0])
+    vals = vals_flat.reshape((nu,) + rest)
+    if axis != 0:
+        vals = jnp.moveaxis(vals, 0, axis)
+    out = _wrap(vals, 0 if a.split is not None else None, a, dtype=a.dtype)
+    if not return_inverse:
+        return out
+    # inverse: each LOGICAL slice's position in the lex-sorted unique
+    # set, found shard-wise by the rows lower-bound binary search
+    # against the small replicated set (no collective; O(n·R·log nu)
+    # like the flat path's searchsorted — bit-view, so NaN/−0 classes
+    # match their collapsed representative)
+    u_log = _ksort.to_sortable(
+        (arr.larray.astype(jnp.uint8) if is_bool else arr.larray).reshape(n, R)
+    )
+    inv_phys = _lex_searchsorted_rows(merged_u, u_log).astype(types.index_jax_type())
+    inv = _wrap(jnp.asarray(inv_phys), 0 if a.split is not None else None, a)
+    return out, inv
+
+
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
     """Unique elements (reference: manipulations.py:3202 — local unique +
     allgather of the small sets + re-unique).
 
-    Distributed flat unique is gather-free: a per-shard sorted-unique
-    compaction, one tiny count sync, and a merge over only the candidate
-    prefixes (``parallel.distributed_unique``) — the operand is never
-    all-gathered. ``axis`` mode (rows-unique) and the single-device path
-    use eager ``jnp.unique`` (data-dependent output shape)."""
+    Distributed unique is gather-free in BOTH modes: flat unique is a
+    per-shard sorted-unique compaction, one tiny count sync, and a merge
+    over only the candidate prefixes (``parallel.distributed_unique``);
+    ``axis`` mode (slices-unique) runs the same sorted-split formulation
+    on ROWS (ISSUE 11 satellite / VERDICT backlog) — slices are
+    bit-viewed through the ``kernels.sort`` monotone transform, sorted
+    lexicographically per shard, deduplicated, and only the candidate
+    prefixes are gathered (``parallel.distributed_unique_rows``) — the
+    operand itself is never all-gathered, and tier-1 pins the census.
+    Tie semantics match the framework's flat unique (−0.0 with +0.0
+    collapse; all NaN payloads collapse to the canonical quiet NaN —
+    ``jnp.unique`` behavior). The single-device path, untransformable
+    dtypes (complex; f64 without x64), and very wide slices (> 256
+    elements — the lexicographic sort keys one operand per element) use
+    eager ``jnp.unique`` (data-dependent output shape)."""
     sanitize_in(a)
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)
+        if a.ndim == 1:
+            axis = None  # 1-D slices ARE the elements: np.unique semantics
     comm = a.comm
+    if (
+        axis is not None
+        and a.split is not None
+        and comm.is_distributed()
+        and 0 not in a.gshape
+    ):
+        out = _unique_axis_distributed(a, axis, return_inverse)
+        if out is not NotImplemented:
+            return out
     if (
         axis is None
         and a.split is not None

@@ -845,6 +845,105 @@ def _unique_merge_program(mesh: Mesh, axis_name: str, p: int, cap: int, jdtype: 
     return jax.jit(fn)
 
 
+def _sorted_dedup_rows(mat, valid):
+    """Rows analog of :func:`_sorted_dedup`: lexicographic ``lax.sort``
+    over (invalid-flag, col_0, …, col_{R-1}) — column 0 is the primary
+    key, invalid rows sink past every valid one — then duplicate-marking
+    compacts the surviving FIRST occurrences to the front. ``mat`` is
+    the (L, R) SORTABLE-uint bit view of the rows
+    (``kernels.sort.to_sortable`` per element), so unsigned comparison
+    IS value order and the collapsed tie classes (−0.0 with +0.0, every
+    NaN payload) dedupe exactly like the framework's flat unique.
+
+    Returns (compacted rows — garbage past the count, count)."""
+    L, R = mat.shape
+    invalid = (~valid).astype(jnp.int8)
+    sorted_ops = lax.sort(
+        (invalid,) + tuple(mat[:, j] for j in range(R)),
+        num_keys=R + 1,
+        is_stable=True,
+    )
+    inv_s = sorted_ops[0]
+    s = jnp.stack(sorted_ops[1:], axis=1)  # (L, R) rows back together
+    first = jax.lax.broadcasted_iota(jnp.int32, (L,), 0) == 0
+    prev = jnp.concatenate([s[:1], s[:-1]], axis=0)
+    differs = jnp.any(s != prev, axis=1)
+    keep = (inv_s == 0) & (first | differs)
+    c = jnp.sum(keep.astype(jnp.int32))
+    idx = jnp.nonzero(keep, size=L, fill_value=L)[0]
+    pad = jnp.zeros((1, R), dtype=s.dtype)
+    return jnp.concatenate([s, pad], axis=0)[idx], c
+
+
+@functools.lru_cache(maxsize=64)
+def _local_unique_rows_program(
+    mesh: Mesh, axis_name: str, blk_shape, n_split: int, jdtype: str
+):
+    """Per-shard sorted ROWS-unique with fixed capacity — the axis-mode
+    counterpart of ``_local_unique_program`` (ISSUE 11 satellite: the
+    gather-free ``unique(axis=)``)."""
+    b0 = blk_shape[0]
+
+    def body(x_blk):
+        r = lax.axis_index(axis_name)
+        valid = (r * b0 + jax.lax.broadcasted_iota(jnp.int32, (b0,), 0)) < n_split
+        cand, c = _sorted_dedup_rows(x_blk, valid)
+        return cand, c.reshape(1)
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(P(axis_name, None),),
+        out_specs=(P(axis_name, None), P(axis_name)), check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _unique_rows_merge_program(mesh: Mesh, axis_name: str, p: int, cap: int, jdtype: str):
+    """Merge the per-shard unique ROW-candidate prefixes: all-gather the
+    (p·cap, R) candidate rows — the candidate set, never the operand —
+    re-sort lexicographically with validity keys, deduplicate;
+    replicated output like the flat merge."""
+
+    def body(cand_blk, cnt_blk):
+        allc = lax.all_gather(cand_blk[:cap], axis_name)     # (p, cap, R)
+        allc = allc.reshape(p * cap, cand_blk.shape[1])
+        counts = lax.all_gather(cnt_blk, axis_name).reshape(-1)
+        pos = jax.lax.broadcasted_iota(jnp.int32, (p * cap,), 0)
+        valid = (pos % cap) < counts[pos // cap]
+        return _sorted_dedup_rows(allc, valid)
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(P(axis_name, None), P(axis_name)),
+        out_specs=(P(), P()), check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def distributed_unique_rows(
+    phys: jax.Array, n_split: int, mesh: Mesh, axis_name: str
+):
+    """Sorted unique ROWS of a split=0 (n, R) SORTABLE-uint matrix
+    without gathering the operand (the sorted-split formulation the
+    VERDICT backlog asked for): per-shard lexicographic sorted-unique
+    compaction, one tiny count sync, and a merge over only the
+    candidate prefixes. The operand itself never crosses the mesh —
+    the only all-gathers carry the (p·cap, R) candidate set.
+
+    Returns the merged unique rows (replicated, sliced to the true
+    count)."""
+    p = mesh.devices.size
+    blk = (phys.shape[0] // p, phys.shape[1])
+    cand, counts = _local_unique_rows_program(
+        mesh, axis_name, blk, n_split, np.dtype(phys.dtype).name
+    )(phys)
+    counts_host = _host_counts(counts)
+    cap = max(int(counts_host.max()), 1)
+    merged, total = _unique_rows_merge_program(
+        mesh, axis_name, p, cap, np.dtype(phys.dtype).name
+    )(cand, counts)
+    return merged[: int(jax.device_get(total))]
+
+
 def distributed_unique(
     phys: jax.Array, n_split: int, mesh: Mesh, axis_name: str
 ):
@@ -868,7 +967,10 @@ def distributed_unique(
     return merged[: int(jax.device_get(total))]
 
 
-__all__ += ["compact_select", "distributed_unique", "distributed_nonzero"]
+__all__ += [
+    "compact_select", "distributed_unique", "distributed_unique_rows",
+    "distributed_nonzero",
+]
 
 
 from .communication import register_mesh_cache
@@ -885,3 +987,5 @@ register_mesh_cache(_balanced_gather_program)
 register_mesh_cache(_nonzero_compact_program)
 register_mesh_cache(_local_unique_program)
 register_mesh_cache(_unique_merge_program)
+register_mesh_cache(_local_unique_rows_program)
+register_mesh_cache(_unique_rows_merge_program)

@@ -41,15 +41,27 @@ halved (bf16); ``=0`` (and every non-admissible path) is exact-bit;
 bytes per tier (DCN ≈ 8× ICI), decomposes cross-slice all-to-alls into
 the ``hierarchical-a2a`` intra-slice pivot + inter-slice exchange, and
 the codec targets the DCN hop first; unset/flat is byte-identical to
-the pre-topology plans.
+the pre-topology plans;
+``HEAT_TPU_OOC=0/1/auto`` gates the out-of-core staging executor
+(ISSUE 11, :mod:`~heat_tpu.redistribution.staging`) — HOST-tier
+operands (:class:`HostArray`: pinned host RAM or HDF5) stream
+(8,128)-aligned windows through a depth-2 double-buffered HBM slab as
+``host-staging`` plans whose ``stage_in``/``stage_out`` steps ride the
+``pcie`` edge of the memory-tier lattice (``ht.core.tiers``), proven
+to fit ``capacity("hbm")`` by ``Schedule.liveness()`` before running;
+``0`` is the exact-bit escape hatch, ``1`` forces the staged window
+forms (bit-identical by construction — the hsvd sketch passes share a
+fixed tile grain with the in-HBM programs).
 """
 
 from . import executor
 from . import planner
 from . import schedule as schedule_ir
 from . import spec as spec_mod
+from . import staging
 
 from .executor import execute, reshape_phys, resplit_phys
+from .staging import HostArray, ooc_mode, plan_staged_passes, prove_fits
 from .planner import (
     budget_bytes,
     clear_plan_cache,
@@ -67,6 +79,7 @@ from .schedule import Schedule, Step
 from .spec import RedistSpec
 
 __all__ = [
+    "HostArray",
     "RedistSpec",
     "Schedule",
     "Step",
@@ -75,9 +88,12 @@ __all__ = [
     "execute",
     "explain",
     "golden_specs",
+    "ooc_mode",
     "overlap_mode",
     "plan",
+    "plan_staged_passes",
     "planner_enabled",
+    "prove_fits",
     "reshape_phys",
     "resolve_topology",
     "resplit_phys",

@@ -254,9 +254,9 @@ def wire_quant_gate() -> Optional[str]:
 
 
 def _dcn_penalty() -> int:
-    from ..core import communication as _comm
+    from ..core import tiers as _tiers
 
-    return _comm.DCN_PENALTY
+    return _tiers.penalty("dcn")
 
 
 def resolve_topology(mesh_size: int, override=None) -> Optional[Tuple[int, int]]:
@@ -285,24 +285,31 @@ def _topo_annotation(topo: Tuple[int, int]) -> dict:
 
 
 def tier_time_model(sched: Schedule) -> dict:
-    """Analytic per-device wall-time split of a plan's collective
-    payload over the two tiers at the v5e constants
-    (``core.communication.ICI_BPS``/``DCN_BPS``) — the checkable model
-    the ``*_2x8_dcn`` bench rows report (no DCN hardware is attached;
-    this is the MULTICHIP methodology). Flat plans price everything at
-    ICI."""
-    from ..core import communication as _comm
+    """Analytic per-device wall-time split of a plan's payload over the
+    lattice edges it rides (``core.tiers.transfer_time`` at the v5e
+    constants) — the checkable model the ``*_2x8_dcn`` and
+    ``*_hostram`` bench rows report (no DCN/PCIe hardware is driven on
+    the CPU container; this is the MULTICHIP methodology). Flat plans
+    price everything at ICI; staged plans (ISSUE 11) additionally carry
+    the ``pcie`` staging traffic."""
+    from ..core import tiers as _tiers
 
     tb = sched.tier_bytes()
-    ici_s = tb["ici"] / _comm.ICI_BPS
-    dcn_s = tb["dcn"] / _comm.DCN_BPS
-    return {
+    ici_s = _tiers.transfer_time(tb["ici"], "ici")
+    dcn_s = _tiers.transfer_time(tb["dcn"], "dcn")
+    out = {
         "ici_bytes": tb["ici"],
         "dcn_bytes": tb["dcn"],
         "ici_s": ici_s,
         "dcn_s": dcn_s,
         "total_s": ici_s + dcn_s,
     }
+    if tb.get("pcie"):
+        pcie_s = _tiers.transfer_time(tb["pcie"], "pcie")
+        out["pcie_bytes"] = tb["pcie"]
+        out["pcie_s"] = pcie_s
+        out["total_s"] = ici_s + dcn_s + pcie_s
+    return out
 
 
 def budget_bytes() -> int:

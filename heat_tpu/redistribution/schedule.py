@@ -71,7 +71,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .spec import RedistSpec
 
-__all__ = ["Step", "Schedule", "COLLECTIVE_STEP_KINDS"]
+__all__ = ["Step", "Schedule", "COLLECTIVE_STEP_KINDS", "STAGING_STEP_KINDS"]
 
 # step kind -> HLO collective op it must compile to (1:1). Every other
 # kind is a local copy/view and must emit NO collective.
@@ -95,6 +95,15 @@ _LOCAL_STEP_KINDS = (
     "quantize", "dequantize",
 )
 
+# ``stage_in``/``stage_out`` (ISSUE 11) are the out-of-core staging
+# transfers (``redistribution.staging``): one (8,128)-tile-aligned
+# window of a host-resident operand device_put into / fetched out of
+# the double-buffered HBM slab. They MOVE bytes — across the host<->HBM
+# PCIe edge of the memory-tier lattice (``core.tiers``), carried as
+# ``tier="pcie"`` — but launch NO mesh collective, so the HLO collective
+# census is untouched by staging.
+STAGING_STEP_KINDS = ("stage_in", "stage_out")
+
 
 class Step:
     """One schedule step.
@@ -103,9 +112,10 @@ class Step:
     ----------
     kind : ``all_to_all`` | ``all_gather`` | ``ppermute`` | ``slice`` |
         ``pad`` | ``reshape`` | ``concat`` | ``pack`` | ``unpack`` |
-        ``quantize`` | ``dequantize``.
-    bytes_moved : per-device payload crossing the mesh (collectives;
-        0 for local steps).
+        ``quantize`` | ``dequantize`` | ``stage_in`` | ``stage_out``.
+    bytes_moved : per-device payload crossing the mesh (collectives) or
+        the host<->HBM PCIe edge (``stage_in``/``stage_out``; 0 for
+        local steps).
     bytes_copied : per-device HBM bytes a LOCAL relayout copy writes
         (0 for views, collectives, and steps whose copy rides another
         step's accounting).
@@ -122,9 +132,11 @@ class Step:
     tier : ``"ici"`` / ``"dcn"`` at a two-tier topology (ISSUE 8):
         which wire a collective step's replica groups ride — ``"ici"``
         for intra-slice subgroups, ``"dcn"`` when the groups span
-        slices. ``None`` for local steps and every flat-topology plan
-        (the key is then omitted from the serialization, keeping flat
-        plans byte-identical to the pre-topology era).
+        slices; ``"pcie"`` on the staging steps (ISSUE 11), the
+        host<->HBM edge of the memory-tier lattice. ``None`` for local
+        steps and every flat-topology plan (the key is then omitted
+        from the serialization, keeping flat plans byte-identical to
+        the pre-topology era).
     """
 
     __slots__ = (
@@ -144,10 +156,20 @@ class Step:
         overlap: Optional[str] = None,
         tier: Optional[str] = None,
     ):
-        if kind not in COLLECTIVE_STEP_KINDS and kind not in _LOCAL_STEP_KINDS:
+        if (
+            kind not in COLLECTIVE_STEP_KINDS
+            and kind not in _LOCAL_STEP_KINDS
+            and kind not in STAGING_STEP_KINDS
+        ):
             raise ValueError(f"unknown step kind {kind!r}")
-        if tier not in (None, "ici", "dcn"):
-            raise ValueError(f"unknown tier {tier!r} (expected 'ici'/'dcn'/None)")
+        if tier not in (None, "ici", "dcn", "pcie"):
+            raise ValueError(f"unknown tier {tier!r} (expected 'ici'/'dcn'/'pcie'/None)")
+        if kind in STAGING_STEP_KINDS and tier != "pcie":
+            raise ValueError(
+                f"staging step {kind!r} must ride the pcie edge (got tier={tier!r})"
+            )
+        if tier == "pcie" and kind not in STAGING_STEP_KINDS:
+            raise ValueError(f"tier 'pcie' is reserved for staging steps (got {kind!r})")
         self.kind = kind
         self.bytes_moved = int(bytes_moved)
         self.bytes_copied = int(bytes_copied)
@@ -225,6 +247,7 @@ class Schedule:
         overlap: Optional[Dict[str, Any]] = None,
         quant: Optional[Dict[str, Any]] = None,
         topology: Optional[Dict[str, Any]] = None,
+        staging: Optional[Dict[str, Any]] = None,
     ):
         self.spec = spec
         self.strategy = strategy
@@ -234,6 +257,12 @@ class Schedule:
         self.overlap = overlap
         self.quant = quant
         self.topology = topology
+        # ISSUE 11: the out-of-core staging annotation
+        # (redistribution.staging) — {depth, axis, window_bytes,
+        # n_windows, slab_bytes, resident_bytes, host_bytes, grain}.
+        # Conditional like quant/topology: non-staged plans serialize
+        # without the key, byte-identical to the pre-staging era.
+        self.staging = staging
         self.plan_id = hashlib.sha1(
             self.canonical_json(with_plan_id=False).encode()
         ).hexdigest()[:12]
@@ -341,7 +370,17 @@ class Schedule:
         built. ``peak_bytes`` deliberately excludes them (it budgets the
         chunkable transients); the liveness view adds them back so the
         number is comparable with a whole-program peak-HBM estimate
-        (``ht.analysis.memcheck``)."""
+        (``ht.analysis.memcheck``).
+
+        STAGED plans (ISSUE 11) override this with the annotation's
+        ``resident_bytes``: the operand itself lives on the HOST tier,
+        so only the outputs held across the window loop are
+        HBM-resident — the slab transients ride ``peak_bytes`` like any
+        other transient, and ``liveness_peak_bytes`` is exactly the
+        number the staging executor proves under
+        ``tiers.capacity("hbm")`` before running."""
+        if self.staging is not None:
+            return int(self.staging["resident_bytes"])
         return int(self.spec.src_shard_bytes) + int(self.spec.dst_shard_bytes)
 
     def liveness(self) -> List[Dict[str, int]]:
@@ -368,13 +407,19 @@ class Schedule:
         return self.resident_bytes + self.peak_bytes
 
     def tier_bytes(self) -> Dict[str, int]:
-        """Per-tier collective payload split: ``{"ici": B, "dcn": B}``.
-        Flat plans (every pre-topology schedule) report all movement as
-        ``"ici"`` — one ICI domain is tier 0 by definition."""
+        """Per-tier payload split: ``{"ici": B, "dcn": B}`` over the
+        collectives (flat plans — every pre-topology schedule — report
+        all movement as ``"ici"``: one ICI domain is tier 0 by
+        definition), plus a ``"pcie"`` entry when the plan stages
+        windows across the host edge (ISSUE 11; the key is present only
+        on staged plans, so established ``{"ici", "dcn"}`` consumers
+        are unchanged)."""
         out = {"ici": 0, "dcn": 0}
         for s in self.steps:
             if s.is_collective:
                 out[s.tier or "ici"] += s.bytes_moved
+            elif s.kind in STAGING_STEP_KINDS:
+                out["pcie"] = out.get("pcie", 0) + s.bytes_moved
         return out
 
     def collective_counts(self) -> Dict[str, int]:
@@ -410,6 +455,10 @@ class Schedule:
         # their bytes — and plan_ids — match the pre-topology era exactly
         if self.topology is not None:
             d["topology"] = self.topology
+        # conditional (ISSUE 11): same contract for the staging
+        # annotation — non-staged plans stay byte-identical
+        if self.staging is not None:
+            d["staging"] = self.staging
         if with_plan_id:
             d["plan_id"] = self.plan_id
         return d
@@ -489,6 +538,24 @@ class Schedule:
                 f"ici={tb['ici']} B  dcn={tb['dcn']} B "
                 f"(dcn priced {t['dcn_penalty']}x — "
                 f"time-eq {tb['ici'] + tb['dcn'] * t['dcn_penalty']} B)"
+            )
+        if self.staging:
+            sg = self.staging
+            passes = ", ".join(
+                f"{p['tag']}(axis {p['axis']}: {p['n_windows']}w"
+                + ("+wb" if p.get("writeback") else "")
+                + ")"
+                for p in sg["passes"]
+            )
+            model = sg["model"]
+            lines.append(
+                f"  staging: depth={sg['depth']} [{passes}]  "
+                f"{sg['n_windows']} window(s) x <= {sg['window_bytes']} B "
+                f"over pcie  slab={sg['slab_bytes']} B  "
+                f"hbm-resident={sg['resident_bytes']} B  "
+                f"host-resident={sg['host_bytes']} B  "
+                f"model: pcie {model['pcie_s']}s / critical path "
+                f"{model['critical_path_s']}s ({model['bound_gbps']} GB/s)"
             )
         if self.notes:
             lines.append(f"  notes: {self.notes}")

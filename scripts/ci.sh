@@ -128,6 +128,18 @@ rm -rf "$srv_store" "$srv_scratch"
 
 HEAT_TPU_SERVING_AOT=0 python -m pytest tests/test_serving.py tests/test_jit.py tests/test_jit_sweep.py -q "$@"
 
+# out-of-core staging legs (ISSUE 11), mirroring the kernel legs:
+# (22) HEAT_TPU_OOC=1 FORCES the staged window pipeline — every
+# rank-budget hsvd sketch on the supported (single-device-orientation)
+# path runs host->slab->compute windows — over the linalg + cluster +
+# redistribution suites, which must stay green AND bit-identical to
+# the in-HBM forms (tests/test_staging.py pins the sweep); (23) the
+# HEAT_TPU_OOC=0 escape hatch: staging never engages, HostArray twins
+# materialize, exact pre-staging program forms
+HEAT_TPU_OOC=1 python -m pytest tests/test_staging.py tests/test_linalg.py tests/test_estimators.py tests/test_redistribution.py -q "$@"
+
+HEAT_TPU_OOC=0 python -m pytest tests/test_staging.py tests/test_linalg.py -q "$@"
+
 python scripts/lint.py heat_tpu/
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
@@ -143,7 +155,10 @@ XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
 # identity catches nondeterminism, the verifier catches a plan that is
 # deterministically MALFORMED (broken composition/conservation/codec
 # pairing/tier labels/overlap structure/plan-id) and fails the leg with
-# the violated invariant named
+# the violated invariant named. ISSUE 11 adds the staged golden plans
+# (host-staging window schedules) to every dump: the staging invariant
+# (stage pairing, window conservation, depth-2 slab occupancy, lattice
+# time model) is proven on each
 plans_a="$(mktemp)"; plans_b="$(mktemp)"
 python scripts/redist_plans.py > "$plans_a"
 python scripts/redist_plans.py > "$plans_b"

@@ -62,6 +62,18 @@ def main() -> int:
     for name, spec in planner.golden_specs():
         sched = planner.plan(spec, budget, quant="int8", topology=topology)
         print(f"{name}.quant{suffix}\t{sched.canonical_json()}")
+
+    # ISSUE 11: the out-of-core staged golden plans ride the same
+    # determinism + verify_plan sweep. Slab/working-set bytes are pinned
+    # inside golden_staged_plans (NOT the ambient HEAT_TPU_OOC* env),
+    # and host-staging plans are topology-free (mesh_size 1, no
+    # collectives), so the tiered dump rows are identical to the flat
+    # ones by construction — dumped in every topology run so each diff
+    # pair covers them.
+    from heat_tpu.redistribution import staging
+
+    for name, sched in staging.golden_staged_plans():
+        print(f"{name}{suffix}\t{sched.canonical_json()}")
     return 0
 
 
