@@ -4,7 +4,7 @@ The north-star contract — compiled programs launch exactly the
 collectives the algorithm needs, every intermediate stays distributed,
 nothing round-trips through the host — is a *static* property of the
 traced program and the source tree. This package checks it before any
-TPU minute is spent, in three passes:
+TPU minute is spent, in four passes:
 
 - **Pass 1, IR lint** — :func:`ht.analysis.check(fn, *args) <check>`
   walks the jaxpr and compiled StableHLO of any heat_tpu program
@@ -30,6 +30,19 @@ TPU minute is spent, in three passes:
   lap structure and plan-id integrity — swept over every golden-matrix
   plan in tier-1 and the ci.sh determinism leg.
 
+- **Pass 4, effect lint** — :mod:`~heat_tpu.analysis.effectcheck`
+  (``gatecheck`` + ``racecheck``; CLI: ``python scripts/lint.py
+  heat_tpu/ --pass effectcheck``) proves the properties BETWEEN
+  programs: SL401 use-after-donate (jaxpr dataflow on the shared
+  ``_donation.py`` resolver, also folded into :func:`check`), SL402
+  gate/cache-key staleness over the ``heat_tpu.core.gates`` registry
+  (the rule that mechanizes "the gate is a component of every program
+  cache key"), SL403 raw ``HEAT_TPU_*`` env reads bypassing the
+  registry, SL404 lock-discipline race lint over the threaded
+  dispatcher/telemetry classes, and SL405 the depth-2 issue/consume
+  pipeline protocol (static loop shape + the plan-annotation sweep
+  :func:`check_plan_protocol`).
+
 Legitimate host boundaries are declared, by name and category, in
 :mod:`~heat_tpu.analysis.boundaries` — the whitelist is code, reviewed
 like code, and tier-1 pins its exact ``core/`` population. Rule
@@ -37,12 +50,14 @@ catalog and workflow: docs/PERF.md § Static analysis.
 """
 
 from . import boundaries
+from . import effectcheck
 from . import findings
 from . import ircheck
 from . import planverify
 from . import srclint
 
 from .boundaries import HOST_BOUNDARIES, is_declared_sync
+from .effectcheck import check_donation, check_plan_protocol
 from .findings import RULES, AnalysisReport, Finding
 from .ircheck import check
 from .memcheck import hbm_budget_bytes, memcheck
@@ -56,6 +71,8 @@ __all__ = [
     "PlanVerificationError",
     "RULES",
     "check",
+    "check_donation",
+    "check_plan_protocol",
     "hbm_budget_bytes",
     "is_declared_sync",
     "lint_paths",

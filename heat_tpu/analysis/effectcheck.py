@@ -1,0 +1,1023 @@
+"""Pass 4: effect lint — ``gatecheck`` + ``racecheck``.
+
+The first three analyzer passes prove properties of one compiled
+program (ircheck), the source tree's jax hygiene (srclint), and one
+program's memory (memcheck). This pass proves the properties that sit
+BETWEEN programs — the ones benchmarks never catch because every
+individual program is correct:
+
+- **SL401 use-after-donate** — jaxpr dataflow on the shared donation
+  resolver (:mod:`~heat_tpu.analysis._donation`): an operand whose
+  buffer a call donates (``donated_invars``) is read — or returned —
+  by anything AFTER that call. The donating program may have already
+  overwritten the bytes in place; on real hardware the read returns
+  garbage nondeterministically, which is why the rule is static.
+- **SL402 gate/cache-key staleness** — the rule that mechanizes the
+  convention every PR since 5 carried by hand ("the gate is a component
+  of every program cache key"): a ``HEAT_TPU_*`` read (a registered
+  accessor, ``gates.get``, or a raw read) reachable from an
+  ``lru_cache``-wrapped or dict-cached program builder whose cache key
+  does not carry the gate. The registry (:mod:`heat_tpu.core.gates`)
+  declares, per gate, the conventional parameter names its resolved
+  value travels under (``key_params``) — a builder keys on a gate by
+  taking one of them as a parameter (lru caches key on parameters), or
+  by folding the gate-derived local into the dict-cache key tuple.
+- **SL403 raw-gate-read** — ``os.environ`` consulted for a
+  ``HEAT_TPU_*`` name anywhere outside ``core/gates.py``. The registry
+  is the one sanctioned read site; a raw read bypasses declaration,
+  legal-value documentation, AND the AOT stamp derivation.
+- **SL404 lock-discipline race lint** — over the threaded classes (a
+  class that spawns a ``threading.Thread`` on one of its own methods,
+  or that owns locks): an attribute written on the worker path and
+  touched on a client path must have ONE lock covering all its accesses
+  on both paths; in lock-owning classes, an attribute guarded at some
+  sites and bare at others is flagged the same way. Deliberate
+  lock-free designs are declared, reviewably, with
+  ``# racecheck: guarded-by(<what>) -- reason`` on any access (or
+  ``__init__`` assignment) line of the attribute.
+- **SL405 pipeline-protocol** — the depth-2 double-buffer skeletons
+  (``executor._run_laps``, ``staging.stream_windows``, and anything
+  shaped like them): a loop that claims depth 2 (prologue prefetch of
+  lap 0) must issue lap k+1 BEFORE consuming lap k, must never consume
+  the lap it just issued (the unfenced buffer), and must consume the
+  final carried lap after the loop. :func:`check_plan_protocol` is the
+  dynamic half: a Schedule's overlap/staging annotation must describe a
+  real depth-2 structure (tagged laps >= 2, critical path < sequential)
+  — swept over every golden plan form in tier-1.
+
+Scope and honesty: SL402's reachability is the intra-module call graph
+(a bare call to a function defined in the same module, plus direct
+calls to registered accessors wherever they were imported from) — the
+resolution-at-the-caller idiom the executor uses (resolve the gate in
+``execute()``, pass ``pipelined``/``wire``/``topo`` into the cached
+builder) is exactly what the rule rewards. SL404 analyzes ``self.``
+attributes per class (module-level globals under module-level locks are
+srclint's concern, not modeled here).
+
+Inline escape hatch, same grammar as the other passes::
+
+    x = os.environ.get("HEAT_TPU_OOC")  # shardlint: ignore[SL403] -- why
+
+CLI: ``python scripts/lint.py heat_tpu/ --pass effectcheck``
+(text/json/sarif; error severity gates the ci.sh leg). Rule catalog:
+:data:`heat_tpu.analysis.findings.RULES` / docs/PERF.md § Static
+analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..core import gates as _gates
+from .findings import AnalysisReport, Finding
+from .srclint import (
+    _call_name,
+    _iter_py_files,
+    _pragmas_of,
+    _suppressed,
+    _walk_scoped,
+    _Scope,
+)
+
+__all__ = [
+    "check_donation",
+    "check_plan_protocol",
+    "lint_paths",
+    "lint_source",
+    "scan_jaxpr_donation",
+]
+
+
+# --------------------------------------------------------------------- #
+# SL401 — use-after-donate (jaxpr dataflow)                             #
+# --------------------------------------------------------------------- #
+def _is_var(v) -> bool:
+    return type(v).__name__ != "Literal"
+
+
+def _donating_invars(eqn) -> List[Any]:
+    """The invars an equation DONATES: the positions its
+    ``donated_invars`` param marks (pjit and friends carry it)."""
+    flags = eqn.params.get("donated_invars")
+    if not flags:
+        return []
+    return [v for v, d in zip(eqn.invars, flags) if d and _is_var(v)]
+
+
+def _eqn_name(eqn) -> str:
+    name = getattr(eqn.primitive, "name", str(eqn.primitive))
+    inner = eqn.params.get("name") or getattr(
+        eqn.params.get("jaxpr"), "jaxpr", None
+    )
+    if isinstance(inner, str):
+        return f"{name}[{inner}]"
+    return name
+
+
+def scan_jaxpr_donation(closed, label: str = "") -> List[Finding]:
+    """Rule SL401 over one (closed) jaxpr: walk the equations in
+    program order; every invar a call-equation donates is DEAD past
+    that equation — a later read, or returning it, is a use of a buffer
+    the donating program may already have overwritten in place. Returns
+    findings (empty = clean). Top-level dataflow: donation inside a
+    nested call kills the var for the REST of the enclosing program,
+    which is the level the bug class lives at (an eager caller reusing
+    an array it passed to a donating ``ht.jit`` program)."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    findings: List[Finding] = []
+    dead: Dict[Any, Tuple[int, str]] = {}
+    where = f" in {label}" if label else ""
+    for idx, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if _is_var(v) and v in dead:
+                d_idx, d_name = dead[v]
+                aval = getattr(v, "aval", None)
+                findings.append(
+                    Finding(
+                        "SL401",
+                        "error",
+                        f"use-after-donate{where}: operand "
+                        f"{aval if aval is not None else v} was donated by "
+                        f"step #{d_idx} ({d_name}) and is read again by step "
+                        f"#{idx} ({_eqn_name(eqn)}) — the donating program "
+                        "may have overwritten the buffer in place; keep a "
+                        "copy, or stop donating it",
+                        op=_eqn_name(eqn),
+                    )
+                )
+        for v in _donating_invars(eqn):
+            dead.setdefault(v, (idx, _eqn_name(eqn)))
+    for v in jaxpr.outvars:
+        if _is_var(v) and v in dead:
+            d_idx, d_name = dead[v]
+            findings.append(
+                Finding(
+                    "SL401",
+                    "error",
+                    f"use-after-donate{where}: a donated operand (donated by "
+                    f"step #{d_idx}, {d_name}) is RETURNED from the program — "
+                    "the caller receives a buffer the callee was told it may "
+                    "destroy",
+                    op=d_name,
+                )
+            )
+    return findings
+
+
+def check_donation(fn, *args, donate_argnums=None, **kwargs) -> AnalysisReport:
+    """Trace ``fn(*args, **kwargs)`` (same argument contract as
+    :func:`ht.analysis.check`) and run rule SL401 over its jaxpr. The
+    checked fn's OWN donation — resolved through the shared
+    ``analysis/_donation.py`` resolver, so this pass and SL105/SL302
+    can never disagree about what was donated — is recorded in the
+    report context; inner donating calls are the dataflow subjects."""
+    import jax
+
+    from . import _donation
+    from ..observability.hlo import _build_traceable
+
+    kind, target, traced_in = _build_traceable(fn, args, kwargs)
+    if kind == "lower":
+        try:
+            closed = jax.make_jaxpr(target)(*args, **kwargs)
+        except TypeError:
+            closed = target.trace(*args, **kwargs).jaxpr
+    else:
+        closed = jax.make_jaxpr(target)(*traced_in)
+    label = getattr(fn, "__name__", "")
+    findings = scan_jaxpr_donation(closed, label=label)
+    context = {
+        "pass": "effectcheck/donation",
+        "donate_argnums": list(
+            _donation.declared_donate_argnums(fn, donate_argnums)
+        ),
+    }
+    return AnalysisReport(findings, context)
+
+
+# --------------------------------------------------------------------- #
+# shared source-pass helpers                                            #
+# --------------------------------------------------------------------- #
+_RACECHECK = re.compile(r"#\s*racecheck:\s*guarded-by\(([^)]*)\)")
+
+_GATES_MODULE = "core/gates.py"
+
+
+def _racecheck_pragmas(src: str) -> Dict[int, str]:
+    """line -> declared guard ('worker-loop', a lock name, ...)."""
+    out: Dict[int, str] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _RACECHECK.search(line)
+        if m:
+            out[i] = m.group(1).strip()
+    return out
+
+
+def _is_os_environ(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+    )
+
+
+def _gate_literal(node: ast.AST, consts: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """The gate name a node denotes: a ``HEAT_TPU_*`` string literal, or
+    a Name bound at module level to one (``OVERLAP_ENV``-style constants
+    — the codebase's historical read idiom, resolved via ``consts``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) and node.value.startswith(_gates.PREFIX):
+        return node.value
+    if consts and isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _module_gate_consts(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "HEAT_TPU_..."`` constant bindings."""
+    out: Dict[str, str] = {}
+    for n in tree.body:
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Constant):
+            v = n.value.value
+            if isinstance(v, str) and v.startswith(_gates.PREFIX):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = v
+    return out
+
+
+def _fn_param_names(fn: ast.FunctionDef) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _is_cached_builder(fn: ast.FunctionDef) -> bool:
+    for deco in fn.decorator_list:
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        if _call_name(target) in ("lru_cache", "cache"):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------- #
+# SL403 — raw env read bypassing the registry                           #
+# --------------------------------------------------------------------- #
+def _gate_scoped_enumerations(tree: ast.Module) -> Set[int]:
+    """ids of ``os.environ`` enumeration calls (items/keys/values) whose
+    enclosing function — or the module top level — names the gate
+    prefix in a string literal: the hand-rolled fingerprint-scan shape
+    SL403 retires. Enumerations with no gate prefix in scope (a generic
+    env diagnostic) are not gate reads and stay unflagged."""
+
+    def has_prefix(node) -> bool:
+        return any(
+            isinstance(n, ast.Constant)
+            and isinstance(n.value, str)
+            and _gates.PREFIX[:-1] in n.value
+            for n in ast.walk(node)
+        )
+
+    out: Set[int] = set()
+    fns = [n for n in ast.walk(tree) if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    in_fn: Set[int] = set()
+    for fn in fns:
+        calls = [
+            n
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr in ("items", "keys", "values")
+            and _is_os_environ(n.func.value)
+        ]
+        in_fn.update(id(c) for c in calls)
+        if calls and has_prefix(fn):
+            out.update(id(c) for c in calls)
+    if has_prefix(tree):
+        for n in ast.walk(tree):
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("items", "keys", "values")
+                and _is_os_environ(n.func.value)
+                and id(n) not in in_fn  # module-level enumeration
+            ):
+                out.add(id(n))
+    return out
+
+
+def _lint_sl403(tree: ast.Module, rel: str, pragmas) -> List[Finding]:
+    if rel.endswith(_GATES_MODULE):
+        return []  # the one sanctioned read site
+    enum_hits = _gate_scoped_enumerations(tree)
+    consts = _module_gate_consts(tree)
+    findings: List[Finding] = []
+
+    def flag(node, scope, what: str) -> None:
+        if _suppressed("SL403", node.lineno, scope, pragmas):
+            return
+        where = scope.qualname or "<module>"
+        findings.append(
+            Finding(
+                "SL403",
+                "error",
+                f"raw gate read in {where}: {what} bypasses the gate "
+                "registry — read it through heat_tpu.core.gates.get "
+                "(declare the gate there first if it is new)",
+                path=rel,
+                line=node.lineno,
+            )
+        )
+
+    for node, scope in _walk_scoped(tree):
+        # os.environ.get / os.getenv with a literal gate name
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in ("get", "getenv", "setdefault", "pop"):
+                env_call = (
+                    _is_os_environ(f.value)
+                    or (f.attr == "getenv" and isinstance(f.value, ast.Name) and f.value.id == "os")
+                )
+                if env_call and node.args:
+                    g = _gate_literal(node.args[0], consts)
+                    if g:
+                        flag(node, scope, f"os.environ read of {g!r}")
+            # os.environ.items()/keys()/values() in a scope that names the
+            # gate prefix: the hand-rolled fingerprint scan SL403 retires
+            # (prefix-free enumerations are not gate reads and pass)
+            elif id(node) in enum_hits:
+                flag(node, scope, "os.environ enumeration over HEAT_TPU_* names (gate fingerprints derive from gates.aot_fingerprint)")
+        # os.environ[<gate literal>] (read or write)
+        elif isinstance(node, ast.Subscript) and _is_os_environ(node.value):
+            g = _gate_literal(node.slice, consts)
+            if g:
+                flag(node, scope, f"os.environ[{g!r}]")
+        # <gate literal> in os.environ
+        elif isinstance(node, ast.Compare) and any(
+            _is_os_environ(c) for c in node.comparators
+        ):
+            g = _gate_literal(node.left, consts)
+            if g:
+                flag(node, scope, f"{g!r} in os.environ (gates.is_set)")
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# SL402 — gate/cache-key staleness                                      #
+# --------------------------------------------------------------------- #
+def _gate_reads_of(fn: ast.FunctionDef, acc_map, prog_gates, consts=None) -> Dict[str, int]:
+    """gate name -> first read line inside ``fn``'s own body (accessor
+    calls, ``gates.get`` with a literal or module-constant name, raw env
+    reads)."""
+    reads: Dict[str, int] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name in acc_map:
+            for g in acc_map[name]:
+                if g in prog_gates:
+                    reads.setdefault(g, node.lineno)
+        elif name in ("get", "is_set", "getenv") and node.args:
+            g = _gate_literal(node.args[0], consts)
+            if g and g in prog_gates:
+                reads.setdefault(g, node.lineno)
+    return reads
+
+
+def _module_dicts(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to dict displays — the hand-rolled
+    program/plan caches SL402's second detector covers."""
+    out: Set[str] = set()
+    for n in tree.body:
+        targets: List[ast.expr] = []
+        value = None
+        if isinstance(n, ast.Assign):
+            targets, value = n.targets, n.value
+        elif isinstance(n, ast.AnnAssign) and n.value is not None:
+            targets, value = [n.target], n.value
+        if value is None:
+            continue
+        is_dict = isinstance(value, ast.Dict) or (
+            isinstance(value, ast.Call) and _call_name(value.func) == "dict"
+        )
+        if is_dict:
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _lint_sl402(tree: ast.Module, rel: str, pragmas) -> List[Finding]:
+    acc_map = _gates.accessor_gates()
+    prog_gates = {s.name for s in _gates.affecting_programs()}
+    consts = _module_gate_consts(tree)
+    findings: List[Finding] = []
+    mod_fns = {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
+
+    # ---- detector 1: lru-cached builder reaching an ambient read ----- #
+    for fn in mod_fns.values():
+        if not _is_cached_builder(fn):
+            continue
+        params = _fn_param_names(fn)
+        # intra-module closure: the builder plus the same-module helpers
+        # it (transitively) calls by bare name
+        seen, todo = {fn.name}, [fn]
+        reads: Dict[str, Tuple[int, str]] = {}
+        while todo:
+            cur = todo.pop()
+            for g, line in _gate_reads_of(cur, acc_map, prog_gates, consts).items():
+                reads.setdefault(g, (line, cur.name))
+            for node in ast.walk(cur):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    callee = mod_fns.get(node.func.id)
+                    if callee is not None and callee.name not in seen:
+                        seen.add(callee.name)
+                        todo.append(callee)
+        for g, (line, via) in sorted(reads.items()):
+            if params & set(_gates.GATES[g].key_params):
+                continue  # the gate's resolved value IS cache-key material
+            scope = _Scope((fn.name,), (fn.lineno,))
+            if _suppressed("SL402", line, scope, pragmas):
+                continue
+            at = fn.name if via == fn.name else f"{fn.name} (via {via})"
+            findings.append(
+                Finding(
+                    "SL402",
+                    "error",
+                    f"stale-key hazard: cached program builder {at!r} reads "
+                    f"{g} ambiently — a gate flip would keep serving the "
+                    "program compiled under the old value. Resolve the gate "
+                    "at the caller and pass it as a parameter (conventional "
+                    f"names: {', '.join(_gates.GATES[g].key_params) or 'declare key_params in core/gates.py'})",
+                    path=rel,
+                    line=line,
+                )
+            )
+
+    # ---- detector 2: dict-cached builder whose key drops a gate ------ #
+    caches = _module_dicts(tree)
+    if caches:
+        for fn in mod_fns.values():
+            key_names: Set[str] = set()
+            uses_cache = False
+            for node in ast.walk(fn):
+                key_expr = None
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("get", "setdefault", "pop")
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in caches
+                    and node.args
+                ):
+                    key_expr = node.args[0]
+                elif (
+                    isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in caches
+                ):
+                    key_expr = node.slice
+                if key_expr is not None:
+                    uses_cache = True
+                    key_names |= _names_in(key_expr)
+            if not uses_cache:
+                continue
+            # key composition: names flowing into locals that the key uses
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and all(
+                    isinstance(t, ast.Name) for t in node.targets
+                ):
+                    if any(t.id in key_names for t in node.targets):
+                        key_names |= _names_in(node.value)
+            # gate-derived locals: assigned from an accessor/registry read
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                target_names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+                if not target_names:
+                    continue
+                for call in ast.walk(node.value):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    name = _call_name(call.func)
+                    hit = [
+                        g for g in acc_map.get(name, ()) if g in prog_gates
+                    ]
+                    if name in ("get", "is_set") and call.args:
+                        g = _gate_literal(call.args[0], consts)
+                        if g and g in prog_gates:
+                            hit.append(g)
+                    for g in hit:
+                        if set(target_names) & key_names:
+                            continue  # the resolved value rides in the key
+                        scope = _Scope((fn.name,), (fn.lineno,))
+                        if _suppressed("SL402", node.lineno, scope, pragmas):
+                            continue
+                        findings.append(
+                            Finding(
+                                "SL402",
+                                "error",
+                                f"stale-key hazard: {fn.name!r} resolves {g} "
+                                f"into {'/'.join(target_names)!r} but the "
+                                "dict-cache key it looks programs up under "
+                                "never includes it — a gate flip would serve "
+                                "the entry cached under the old value",
+                                path=rel,
+                                line=node.lineno,
+                            )
+                        )
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# SL404 — lock-discipline race lint                                     #
+# --------------------------------------------------------------------- #
+_SYNC_TYPES = frozenset({
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "Event", "local",
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore", "Barrier",
+})
+_LOCK_TYPES = frozenset({"Lock", "RLock", "Condition"})
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "remove", "clear", "update", "add", "discard", "setdefault",
+    "sort", "reverse",
+})
+_PUBLIC_DUNDERS = frozenset({
+    "__enter__", "__exit__", "__iter__", "__next__", "__call__", "__del__",
+    "__len__", "__contains__",
+})
+
+
+class _Access:
+    __slots__ = ("attr", "method", "write", "lineno", "locks")
+
+    def __init__(self, attr, method, write, lineno, locks):
+        self.attr = attr
+        self.method = method
+        self.write = write
+        self.lineno = lineno
+        self.locks = frozenset(locks)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _collect_accesses(method: ast.FunctionDef, lock_attrs: Set[str]):
+    """Every ``self.X`` touch in ``method`` with the lexically held
+    locks, plus the intra-class calls (``self.m(...)``) with the locks
+    held at the call site."""
+    accesses: List[_Access] = []
+    calls: List[Tuple[str, frozenset]] = []
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(method):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+
+    def held(node) -> Set[str]:
+        out: Set[str] = set()
+        cur = parents.get(id(node))
+        while cur is not None:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr in lock_attrs:
+                        out.add(attr)
+            cur = parents.get(id(cur))
+        return out
+
+    for node in ast.walk(method):
+        attr = _self_attr(node)
+        if attr is not None:
+            parent = parents.get(id(node))
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            method_called = None
+            if (
+                isinstance(parent, ast.Attribute)
+                and parent.value is node
+                and isinstance(parents.get(id(parent)), ast.Call)
+                and parents[id(parent)].func is parent
+            ):
+                method_called = parent.attr
+            if method_called in _MUTATORS:
+                # self.X.append(...) and friends mutate the container
+                # through a Load-context read
+                write = True
+            if isinstance(parent, ast.Subscript) and parent.value is node:
+                # self.X[...] = / del self.X[...]
+                if isinstance(parent.ctx, (ast.Store, ast.Del)):
+                    write = True
+            accesses.append(_Access(attr, method.name, write, node.lineno, held(node)))
+        # self.m(...) call edges (m resolved against the class below)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self"
+        ):
+            calls.append((node.func.attr, frozenset(held(node))))
+        # getattr(self, "attr", ...) reads
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "getattr"
+            and len(node.args) >= 2
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id == "self"
+            and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)
+        ):
+            accesses.append(
+                _Access(node.args[1].value, method.name, False, node.lineno, held(node))
+            )
+    return accesses, calls
+
+
+def _closure(roots: Set[str], edges: Dict[str, Set[str]]) -> Set[str]:
+    out, todo = set(roots), list(roots)
+    while todo:
+        cur = todo.pop()
+        for nxt in edges.get(cur, ()):
+            if nxt not in out:
+                out.add(nxt)
+                todo.append(nxt)
+    return out
+
+
+def _lint_sl404(tree: ast.Module, rel: str, pragmas, guards: Dict[int, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        methods = {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
+        if not methods:
+            continue
+        # lock/sync attribute discovery (any method, usually __init__)
+        lock_attrs: Set[str] = set()
+        sync_attrs: Set[str] = set()
+        init_assign_lines: Dict[str, List[int]] = {}
+        for m in methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    tname = _call_name(node.value.func)
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        if tname in _LOCK_TYPES:
+                            lock_attrs.add(attr)
+                            sync_attrs.add(attr)
+                        elif tname in _SYNC_TYPES:
+                            sync_attrs.add(attr)
+                if m.name == "__init__" and isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            init_assign_lines.setdefault(attr, []).append(node.lineno)
+        # worker roots: threading.Thread(target=self.m)
+        worker_roots: Set[str] = set()
+        for m in methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call) and _call_name(node.func) == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            attr = _self_attr(kw.value)
+                            if attr in methods:
+                                worker_roots.add(attr)
+                    for a in node.args:
+                        attr = _self_attr(a)
+                        if attr in methods:
+                            worker_roots.add(attr)
+        if not worker_roots and not lock_attrs:
+            continue
+
+        accesses: List[_Access] = []
+        call_edges: Dict[str, Set[str]] = {}
+        call_sites: List[Tuple[str, str, frozenset]] = []
+        for name, m in methods.items():
+            acc, calls = _collect_accesses(m, lock_attrs)
+            accesses += acc
+            for callee, locks in calls:
+                if callee in methods:
+                    call_edges.setdefault(name, set()).add(callee)
+                    call_sites.append((name, callee, locks))
+
+        # lock inheritance: a method whose EVERY intra-class call site
+        # holds lock L is, for discipline purposes, under L (the
+        # telemetry `_prune_locked` pattern: mutate inside a helper,
+        # lock at the one caller). Fixpoint: a call site contributes the
+        # locks it lexically holds plus what its caller inherited.
+        inherited: Dict[str, frozenset] = {}
+        for _ in range(len(methods) + 1):
+            changed = False
+            for callee in {c for _, c, _ in call_sites}:
+                inh = None
+                for caller, c, locks in call_sites:
+                    if c != callee:
+                        continue
+                    eff = locks | inherited.get(caller, frozenset())
+                    inh = eff if inh is None else (inh & eff)
+                inh = inh or frozenset()
+                if inherited.get(callee) != inh:
+                    inherited[callee] = inh
+                    changed = True
+            if not changed:
+                break
+
+        worker = _closure(worker_roots, call_edges)
+        public_roots = {
+            n for n in methods
+            if (not n.startswith("_") or n in _PUBLIC_DUNDERS) and n != "__init__"
+        } - worker_roots
+        client = _closure(public_roots, call_edges)
+
+        by_attr: Dict[str, List[_Access]] = {}
+        for a in accesses:
+            if a.attr in sync_attrs or a.attr in methods:
+                continue  # sync objects and method references are not data
+            by_attr.setdefault(a.attr, []).append(a)
+
+        def annotated(attr: str, accs: List[_Access]) -> bool:
+            lines = {a.lineno for a in accs} | set(init_assign_lines.get(attr, ()))
+            if any(line in guards for line in lines):
+                return True
+            scope = _Scope((cls.name,), (cls.lineno,))
+            return any(_suppressed("SL404", line, scope, pragmas) for line in lines)
+
+        for attr, accs in sorted(by_attr.items()):
+            writes_outside_init = [
+                a for a in accs if a.write and a.method != "__init__"
+            ]
+            if not writes_outside_init:
+                continue
+            live = [a for a in accs if a.method != "__init__"]
+            eff = {
+                id(a): a.locks | inherited.get(a.method, frozenset()) for a in live
+            }
+            if worker_roots:
+                w_acc = [a for a in live if a.method in worker]
+                c_acc = [a for a in live if a.method in client]
+                if w_acc and c_acc:
+                    w_locks = frozenset.intersection(*[frozenset(eff[id(a)]) for a in w_acc])
+                    c_locks = frozenset.intersection(*[frozenset(eff[id(a)]) for a in c_acc])
+                    if not (w_locks & c_locks) and not annotated(attr, live):
+                        sample = writes_outside_init[0]
+                        findings.append(
+                            Finding(
+                                "SL404",
+                                "error",
+                                f"unguarded shared attribute {cls.name}.{attr}: "
+                                f"written on the worker path "
+                                f"({sorted({a.method for a in w_acc if a.write}) or sorted({a.method for a in w_acc})}) "
+                                f"and touched on the client path "
+                                f"({sorted({a.method for a in c_acc})}) with no "
+                                "common lock — guard both sides with one lock, "
+                                "or declare the design with "
+                                "`# racecheck: guarded-by(<what>) -- reason`",
+                                path=rel,
+                                line=sample.lineno,
+                            )
+                        )
+                    continue
+            if lock_attrs:
+                guarded = [a for a in live if eff[id(a)]]
+                bare = [a for a in live if not eff[id(a)]]
+                if guarded and bare and not annotated(attr, live):
+                    findings.append(
+                        Finding(
+                            "SL404",
+                            "error",
+                            f"mixed lock discipline on {cls.name}.{attr}: "
+                            f"guarded at {sorted({a.method for a in guarded})} "
+                            f"but bare at {sorted({a.method for a in bare})} — "
+                            "hold the same lock everywhere, or declare the "
+                            "lock-free design with `# racecheck: "
+                            "guarded-by(<what>) -- reason`",
+                            path=rel,
+                            line=bare[0].lineno,
+                        )
+                    )
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# SL405 — pipeline-protocol (issue/consume ordering)                    #
+# --------------------------------------------------------------------- #
+def _flat_stmts(body: List[ast.stmt]) -> List[Tuple[ast.stmt, bool]]:
+    """Statements of a loop body in source order, flattened through If
+    arms; the bool marks 'conditional' (inside an If)."""
+    out: List[Tuple[ast.stmt, bool]] = []
+    for st in body:
+        if isinstance(st, ast.If):
+            for inner in st.body + st.orelse:
+                out.append((inner, True))
+        else:
+            out.append((st, False))
+    return out
+
+
+def _calls_to(node: ast.AST, name: str) -> List[ast.Call]:
+    return [
+        n for n in ast.walk(node)
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Name)
+        and n.func.id == name
+    ]
+
+
+def _lint_sl405(tree: ast.Module, rel: str, pragmas) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in [n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)]:
+        params = _fn_param_names(fn)
+        if "consume" not in params and "place" not in params:
+            continue
+        consume_name = "consume" if "consume" in params else "place"
+        scope = _Scope((fn.name,), (fn.lineno,))
+
+        def flag(line, msg):
+            if not _suppressed("SL405", line, scope, pragmas):
+                findings.append(Finding("SL405", "error", msg, path=rel, line=line))
+
+        # walk every statement block looking for [prologue assign][for]
+        blocks = [fn.body] + [
+            n.body for n in ast.walk(fn) if isinstance(n, (ast.If, ast.For, ast.While))
+        ] + [n.orelse for n in ast.walk(fn) if isinstance(n, (ast.If, ast.For, ast.While)) if n.orelse]
+        for block in blocks:
+            for i, st in enumerate(block):
+                if not isinstance(st, ast.For):
+                    continue
+                # prologue prefetch: `V = P(...)` directly before the loop
+                producer = carried = None
+                for prev in reversed(block[:i]):
+                    if (
+                        isinstance(prev, ast.Assign)
+                        and len(prev.targets) == 1
+                        and isinstance(prev.targets[0], ast.Name)
+                        and isinstance(prev.value, ast.Call)
+                        and isinstance(prev.value.func, ast.Name)
+                    ):
+                        producer = prev.value.func.id
+                        carried = prev.targets[0].id
+                        break
+                    if isinstance(prev, (ast.Assign, ast.Expr, ast.AugAssign)):
+                        continue
+                    break
+                if producer is None or producer == consume_name:
+                    continue  # not a depth-2 claimant
+                stmts = _flat_stmts(st.body)
+                first_issue = first_consume = None
+                issue_conditional = True
+                inloop_var = None
+                consume_call = None
+                for stmt, cond in stmts:
+                    if first_issue is None and _calls_to(stmt, producer):
+                        first_issue = stmt.lineno
+                        issue_conditional = cond
+                        if (
+                            isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.targets[0], ast.Name)
+                        ):
+                            inloop_var = stmt.targets[0].id
+                    if first_consume is None:
+                        cc = _calls_to(stmt, consume_name)
+                        if cc:
+                            first_consume = stmt.lineno
+                            consume_call = cc[0]
+                if first_consume is None:
+                    continue  # consume happens elsewhere: out of pattern
+                if first_issue is None or first_consume < first_issue:
+                    flag(
+                        first_consume,
+                        f"{fn.name}: depth-2 pipeline consumes lap k before "
+                        f"issuing lap k+1 (prologue prefetches {carried!r} "
+                        f"via {producer!r}, but the loop body runs "
+                        f"{consume_name!r} first) — the overlap the plan's "
+                        "annotation promises never happens",
+                    )
+                    continue
+                if inloop_var is not None and consume_call is not None:
+                    consumed = _names_in(consume_call)
+                    if inloop_var in consumed and carried not in consumed:
+                        flag(
+                            first_consume,
+                            f"{fn.name}: the loop consumes {inloop_var!r} — "
+                            "the lap it JUST issued — instead of the carried "
+                            f"previous lap {carried!r}: an unfenced read of "
+                            "an in-flight buffer (and zero overlap)",
+                        )
+                        continue
+                if not issue_conditional:
+                    tail = block[i + 1:]
+                    if not any(_calls_to(t, consume_name) for t in tail):
+                        flag(
+                            st.lineno,
+                            f"{fn.name}: the final prefetched lap "
+                            f"({carried!r}) is never consumed after the loop "
+                            "— the last lap's result is dropped",
+                        )
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# the source pass                                                       #
+# --------------------------------------------------------------------- #
+def lint_source(src: str, rel: str) -> List[Finding]:
+    """Run the SL402–SL405 source rules over one module. ``rel`` is the
+    repo-relative posix path (what the gates-module exemption and module
+    scoping match on)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        # same rule id + message srclint uses for this condition, so the
+        # two passes report an unparseable module identically
+        return [Finding("SL201", "error", f"unparseable module: {e}", path=rel, line=e.lineno)]
+    rel = rel.replace("\\", "/")
+    pragmas = _pragmas_of(src)
+    guards = _racecheck_pragmas(src)
+    findings: List[Finding] = []
+    findings += _lint_sl403(tree, rel, pragmas)
+    findings += _lint_sl402(tree, rel, pragmas)
+    findings += _lint_sl404(tree, rel, pragmas, guards)
+    findings += _lint_sl405(tree, rel, pragmas)
+    findings.sort(key=lambda f: (f.path or "", f.line or 0, f.rule))
+    return findings
+
+
+def lint_paths(paths, root: Optional[str] = None) -> AnalysisReport:
+    """Pass 4 over every ``.py`` file under ``paths`` (the effectcheck
+    face of ``scripts/lint.py``)."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: List[Finding] = []
+    n_files = 0
+    for path in paths:
+        for fp in _iter_py_files(path):
+            n_files += 1
+            with open(fp, encoding="utf-8") as f:
+                src = f.read()
+            rel = os.path.relpath(os.path.abspath(fp), root).replace(os.sep, "/")
+            findings += lint_source(src, rel)
+    return AnalysisReport(findings, context={"files": n_files, "pass": "effectcheck"})
+
+
+# --------------------------------------------------------------------- #
+# SL405, dynamic half — plan-annotation protocol                        #
+# --------------------------------------------------------------------- #
+def check_plan_protocol(sched) -> List[Finding]:
+    """The Schedule-side SL405 check: an overlap/staging annotation must
+    describe a realizable depth-2 pipeline — depth exactly 2, every
+    group's laps >= 2, every group tag borne by tagged steps, and a
+    critical path strictly below the sequential model (otherwise the
+    annotation promises an overlap the executor cannot deliver). Swept
+    over every golden plan form (flat/2x4/2x8, quant on+off, staged) in
+    tier-1; returns findings (empty = clean)."""
+    findings: List[Finding] = []
+
+    def flag(msg):
+        findings.append(
+            Finding("SL405", "error", f"plan {sched.plan_id}: {msg}")
+        )
+
+    step_tags = {st.overlap for st in sched.steps if st.overlap is not None}
+    overlap = getattr(sched, "overlap", None)
+    if overlap:
+        if overlap.get("depth") != 2:
+            flag(f"overlap annotation at depth {overlap.get('depth')} — the executor implements depth 2")
+        for g in overlap.get("groups", ()):
+            if int(g.get("laps", 0)) < 2:
+                flag(f"overlap group {g.get('tag')!r} has {g.get('laps')} lap(s) — nothing to pipeline")
+            if g.get("tag") not in step_tags:
+                flag(f"overlap group {g.get('tag')!r} tags no step — the issue/consume loop it models does not exist")
+        cp, seq = overlap.get("critical_path_bytes", 0), overlap.get("sequential_bytes", 0)
+        if seq and cp >= seq:
+            flag(f"overlap critical path {cp} >= sequential {seq} — the annotation models no gain yet was kept")
+    staging = getattr(sched, "staging", None)
+    if staging:
+        if staging.get("depth") != 2:
+            flag(f"staging annotation at depth {staging.get('depth')} — stream_windows implements depth 2")
+        n = int(staging.get("n_windows", 0))
+        if n < 1:
+            flag("staging annotation with no windows")
+        model = staging.get("model", {})
+        cp, seq = model.get("critical_path_s", 0.0), model.get("sequential_s", 0.0)
+        if n > 1 and seq and cp >= seq:
+            flag(f"staging critical path {cp} >= sequential {seq} at {n} windows — depth-2 prefetch models no gain")
+    return findings

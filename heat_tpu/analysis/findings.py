@@ -70,6 +70,30 @@ RULES: Dict[str, str] = {
              "threshold stays live across >= 2 collective steps — a "
              "per-device materialization whose residency the redistribution "
              "planner's transient peak accounting never sees",
+    "SL401": "use-after-donate: a donated operand (the shared "
+             "analysis/_donation.py resolution) is read — or returned — "
+             "after the call that donates its buffer; the donating program "
+             "may already have overwritten the bytes in place",
+    "SL402": "gate-staleness: a HEAT_TPU_* gate read is reachable from an "
+             "lru-/dict-cached program builder without being a component of "
+             "that cache's key — a gate flip then serves a stale compiled "
+             "program (the rule that mechanizes the 'gate in every program "
+             "cache key' convention; key material travels under the gate's "
+             "declared key_params, core/gates.py)",
+    "SL403": "raw-gate-read: os.environ consulted for a HEAT_TPU_* name "
+             "outside core/gates.py — every gate read must route through "
+             "the registry (gates.get), where declaration, legal values and "
+             "cache-key derivation live",
+    "SL404": "lock-discipline: an attribute written on a worker-thread path "
+             "and touched on a client path (or guarded at some sites and "
+             "bare at others) without one common lock — annotate "
+             "deliberate lock-free designs with "
+             "`# racecheck: guarded-by(<what>) -- reason`",
+    "SL405": "pipeline-protocol: a depth-2 double-buffer loop (prologue "
+             "prefetch + issue/consume rotation) that consumes lap k before "
+             "issuing lap k+1, consumes the lap it just issued, or drops "
+             "the final carried lap — the overlap the plan's annotation "
+             "promises never happens (or reads an unfenced buffer)",
 }
 
 

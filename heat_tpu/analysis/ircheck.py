@@ -239,6 +239,13 @@ def check(
             return AnalysisReport(findings, context)
         raise
 
+    # ---- SL401: use-after-donate (pass 4 folded into the IR check) ----
+    from .effectcheck import scan_jaxpr_donation
+
+    findings += scan_jaxpr_donation(
+        closed, label=getattr(fn, "__name__", "") or ""
+    )
+
     in_avals = [(tuple(a.shape), str(a.dtype)) for a in closed.in_avals]
     out_avals = [(tuple(a.shape), str(a.dtype)) for a in closed.out_avals]
     in_bytes = [_nbytes(s, d) for s, d in in_avals]

@@ -30,6 +30,7 @@ from .stride_tricks import *
 from .tiling import *
 from .trigonometrics import *
 
+from . import gates
 from . import random
 from . import tiers
 from . import tiling

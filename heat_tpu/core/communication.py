@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import os
 import re
 
 import numpy as np
@@ -40,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from typing import List, Optional, Tuple, Union
 
+from . import gates as _gates
 from ..observability import events as _obs_events
 from ..observability import telemetry as _telemetry
 from ..observability.instrument import nbytes_of as _nbytes_of
@@ -227,7 +227,7 @@ def topology_for(mesh_size: int, override=None) -> Topology:
                     "'flat', or a Topology)"
                 )
         return t if t.size == mesh_size and t.tiered else Topology(1, mesh_size)
-    raw = os.environ.get(TOPOLOGY_ENV, "auto").strip().lower()
+    raw = _gates.get(TOPOLOGY_ENV, "auto").strip().lower()
     if raw in ("", "auto"):
         return _detect_slices(mesh_size)
     if raw in ("flat", "1", "none", "off", "0"):

@@ -42,9 +42,9 @@ the pure-Python plan dump scripts all import it without touching jax.
 
 from __future__ import annotations
 
-import os
-
 from typing import Dict, Optional, Tuple
+
+from . import gates as _gates
 
 __all__ = [
     "DCN_BPS",
@@ -154,7 +154,7 @@ def capacity(tier: str) -> int:
             "wire tiers 'ici'/'dcn' carry bytes, they do not hold them)"
         )
     env, default = _CAPACITY[tier]
-    raw = os.environ.get(env, "")
+    raw = _gates.get(env, "")
     try:
         b = int(raw) if raw.strip() else default
     except ValueError:

@@ -50,13 +50,14 @@ of the HBM amplification a copy through that layout pays.
 from __future__ import annotations
 
 import functools
-import os
 import time
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from ..core import gates as _gates
 
 try:  # pragma: no cover — present in all TPU-capable jax builds
     from jax.experimental import pallas as pl
@@ -95,7 +96,7 @@ _MAX_BLOCK_ROWS = 4096
 
 
 def _mode() -> str:
-    v = os.environ.get("HEAT_TPU_RELAYOUT_KERNEL", "auto").strip().lower()
+    v = _gates.get("HEAT_TPU_RELAYOUT_KERNEL", "auto").strip().lower()
     if v in ("0", "off", "false"):
         return "0"
     if v in ("1", "on", "true", "force"):

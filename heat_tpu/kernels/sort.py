@@ -55,7 +55,6 @@ path that loses on the real chip can never regress a workload.
 from __future__ import annotations
 
 import functools
-import os
 import time
 
 import numpy as np
@@ -63,6 +62,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..core import gates as _gates
 
 try:  # pragma: no cover — present in all TPU-capable jax builds
     from jax.experimental import pallas as pl
@@ -92,7 +93,7 @@ _VMEM_SORT_LOG2 = 20         # ~elements of a (key,idx) pair set resident in
 
 
 def _mode() -> str:
-    v = os.environ.get("HEAT_TPU_SORT_KERNEL", "auto").strip().lower()
+    v = _gates.get("HEAT_TPU_SORT_KERNEL", "auto").strip().lower()
     if v in ("0", "off", "false"):
         return "0"
     if v in ("1", "on", "true", "force"):

@@ -52,12 +52,15 @@ from __future__ import annotations
 import collections
 import contextlib
 import json
-import os
 import threading
 import time
 import weakref
 
 from typing import Any, Dict, Iterator, Optional
+
+# stdlib-only sibling (the gate registry) — safe to import this early in
+# process start, before jax or any heavy core module loads
+from ..core import gates as _gates
 
 __all__ = [
     "Registry",
@@ -258,7 +261,7 @@ _REGISTRY = Registry()
 
 # hooks read this attribute directly (one dict lookup + attribute read):
 # the whole disabled-path cost of the instrumentation
-_ENABLED: bool = _env_truthy(os.environ.get("HEAT_TPU_TELEMETRY"))
+_ENABLED: bool = _env_truthy(_gates.get("HEAT_TPU_TELEMETRY"))
 
 # record() nesting is per thread: names join with '/'
 _NESTING = threading.local()

@@ -115,13 +115,13 @@ PR-1 telemetry registry: ``redist.plan_cache.{hit,miss}``,
 
 from __future__ import annotations
 
-import os
 import threading
 
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..core import gates as _gates
 from ..observability import events as _obs_events
 from ..observability import telemetry as _telemetry
 from .schedule import Schedule, Step
@@ -194,7 +194,7 @@ _PLAN_CACHE_MAX = 4096
 def planner_enabled() -> bool:
     """Planner routing switch (``HEAT_TPU_REDIST_PLANNER=0`` restores
     the legacy single-device_put relayout paths)."""
-    val = os.environ.get(_ENABLE_ENV, "1").strip().lower()
+    val = _gates.get(_ENABLE_ENV, "1").strip().lower()
     return val not in ("0", "false", "off", "no")
 
 
@@ -208,7 +208,7 @@ def overlap_mode() -> str:
     unchanged) while the linalg ring decompositions, which trade an
     all-gather/all-reduce for a byte-equivalent ppermute ring, engage
     only on the TPU backend where the latency hiding pays."""
-    v = os.environ.get(OVERLAP_ENV, "auto").strip().lower()
+    v = _gates.get(OVERLAP_ENV, "auto").strip().lower()
     if v in ("0", "off", "false", "no"):
         return "0"
     if v in ("1", "on", "true", "force", "yes"):
@@ -226,7 +226,7 @@ def wire_quant_mode() -> str:
     ICI wire is the modeled binding term and the pinned tolerance is
     the documented trade — and keeps every other backend exact-bit, so
     the CPU tier-1 contracts hold untouched by default."""
-    v = os.environ.get(WIRE_QUANT_ENV, "auto").strip().lower()
+    v = _gates.get(WIRE_QUANT_ENV, "auto").strip().lower()
     if v in ("0", "off", "false", "no"):
         return "0"
     if v in ("1", "on", "true", "force", "yes", "int8"):
@@ -315,7 +315,7 @@ def tier_time_model(sched: Schedule) -> dict:
 def budget_bytes() -> int:
     """Per-device peak-memory budget for redistribution transients
     (``HEAT_TPU_REDIST_BUDGET_MB``, default 256 MiB)."""
-    raw = os.environ.get(_BUDGET_ENV, "")
+    raw = _gates.get(_BUDGET_ENV, "")
     try:
         mb = int(raw) if raw.strip() else DEFAULT_BUDGET_MB
     except ValueError:

@@ -47,12 +47,11 @@ what the pinned staged-vs-in-HBM bit-identity sweep relies on.
 
 from __future__ import annotations
 
-import os
-
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core import gates as _gates
 from ..observability import events as _obs_events
 from ..observability import telemetry as _telemetry
 from .schedule import Schedule, Step
@@ -103,7 +102,7 @@ def ooc_mode() -> str:
     supported paths (the CI leg: every windowed program form executes,
     and the results are pinned bit-identical to the in-HBM forms);
     ``auto`` (default) stages host-resident operands only."""
-    v = os.environ.get(OOC_ENV, "auto").strip().lower()
+    v = _gates.get(OOC_ENV, "auto").strip().lower()
     if v in ("0", "off", "false", "no"):
         return "0"
     if v in ("1", "on", "true", "force", "yes"):
@@ -133,7 +132,7 @@ def slab_bytes(override: Optional[int] = None) -> int:
 
     if override is not None:
         return max(1, int(override))
-    raw = os.environ.get(SLAB_ENV, "")
+    raw = _gates.get(SLAB_ENV, "")
     try:
         mb = int(raw) if raw.strip() else DEFAULT_SLAB_MB
     except ValueError:

@@ -85,12 +85,15 @@ __all__ = [
 
 _FORMAT = 1
 
-# env gates whose value changes the PROGRAMS the library builds — they
-# are part of every persistent key so a cache written under one gate
-# combination never serves a process running another. (The serving and
-# telemetry gates themselves change no program bytes and stay out.)
-_GATE_PREFIX = "HEAT_TPU_"
-_GATE_EXCLUDE = ("HEAT_TPU_SERVING", "HEAT_TPU_TELEMETRY")
+# env gates whose value changes the PROGRAMS the library builds are part
+# of every persistent key, so a cache written under one gate combination
+# never serves a process running another. Which gates those ARE is no
+# longer a hand-listed prefix scan: the set derives from the registry's
+# ``affects_programs`` declarations (heat_tpu/core/gates.py) — the
+# serving and telemetry switches are the registered
+# ``affects_programs=False`` entries the old exclusion list spelled by
+# prefix. Byte-compatible with the PR 9 filter at every combination.
+from ..core import gates as _gates
 
 
 # the truthy spellings are the telemetry module's — one definition,
@@ -104,20 +107,16 @@ def _env_falsy(value: Optional[str]) -> bool:
 
 def cache_dir() -> str:
     """The store root: ``HEAT_TPU_SERVING_CACHE`` or the user default."""
-    return os.environ.get(
+    return _gates.get(
         "HEAT_TPU_SERVING_CACHE",
         os.path.join(os.path.expanduser("~"), ".cache", "heat_tpu", "aot"),
     )
 
 
 def _gate_fingerprint() -> Tuple[Tuple[str, str], ...]:
-    return tuple(
-        sorted(
-            (k, v)
-            for k, v in os.environ.items()
-            if k.startswith(_GATE_PREFIX) and not k.startswith(_GATE_EXCLUDE)
-        )
-    )
+    """(name, raw value) of every program-affecting gate that is set —
+    registry-derived (``gates.aot_fingerprint``), empty at defaults."""
+    return _gates.aot_fingerprint()
 
 
 def _runtime_stamps() -> Dict[str, Any]:
@@ -131,6 +130,20 @@ def _runtime_stamps() -> Dict[str, Any]:
         "platform": jax.default_backend(),
         "devices": int(jax.device_count()),
     }
+
+
+def _envelope_stamps() -> Dict[str, Any]:
+    """What every stored envelope's meta must match at load: the runtime
+    stamps PLUS the registered program-affecting gate ROSTER
+    (``gates.program_gate_roster``). The roster rides in the meta, never
+    the key: registering a new program-affecting gate in a later version
+    changes the roster, so every envelope written under the old one is
+    refused as ``version_mismatch`` — the old artifacts may predate the
+    gate's subsystem entirely, and a recompile is the only safe answer
+    (never a stale hit)."""
+    stamps = _runtime_stamps()
+    stamps["gate_roster"] = _gates.program_gate_roster()
+    return stamps
 
 
 def _key_stamps() -> tuple:
@@ -256,10 +269,11 @@ class AOTStore:
             except OSError:
                 pass
             return None
-        stamps = _runtime_stamps()
+        stamps = _envelope_stamps()
         if {k: rec["meta"].get(k) for k in stamps} != stamps:
-            # written by another jax/heat_tpu version, platform or world
-            # size: recompile (and overwrite) rather than trust it
+            # written by another jax/heat_tpu version, platform, world
+            # size, or program-affecting gate roster: recompile (and
+            # overwrite) rather than trust it
             self._count("version_mismatch")
             return None
         self._count("hit")
@@ -272,7 +286,7 @@ class AOTStore:
         """Atomically persist one envelope; never raises."""
         try:
             os.makedirs(self.root, exist_ok=True)
-            meta = _runtime_stamps()
+            meta = _envelope_stamps()
             if extra_meta:
                 meta.update(extra_meta)
             rec = {"format": _FORMAT, "meta": meta, "exported": exported_bytes, "out": out}
@@ -581,10 +595,12 @@ def _auto_configure() -> None:
     """Import-time gate resolution (see module docstring). The default —
     no serving env set — leaves the hooks uninstalled: tier-1 and every
     non-serving process run the exact pre-serving code paths."""
-    mode = os.environ.get("HEAT_TPU_SERVING_AOT")
+    mode = _gates.get("HEAT_TPU_SERVING_AOT")
     if _env_falsy(mode):
         return
-    if _env_truthy(mode) or ("HEAT_TPU_SERVING_CACHE" in os.environ and mode in (None, "", "auto")):
+    if _env_truthy(mode) or (
+        _gates.is_set("HEAT_TPU_SERVING_CACHE") and mode in (None, "", "auto")
+    ):
         configure()
 
 

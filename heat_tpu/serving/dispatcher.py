@@ -150,8 +150,15 @@ class Dispatcher:
         self.admission = admission or AdmissionControl(max_queue=max_queue)
         self.name = name or endpoint.name
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=self.admission.max_queue)
-        self._carry: collections.deque = collections.deque()
+        # worker-owned batching state; the only client touches are the
+        # post-join sweep in stop() and the raced-stop sweep in submit(),
+        # both of which run strictly AFTER the worker exited
+        self._carry: collections.deque = collections.deque()  # racecheck: guarded-by(worker-loop; clients sweep only after join)
         self._poll_s = float(poll_s)
+        # monotonic shutdown flag: written by stop() BEFORE _stop.set(),
+        # read by the worker only after it observes _stop — the Event is
+        # the fence
+        self._drain_on_stop = True  # racecheck: guarded-by(_stop event ordering)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lat: collections.deque = collections.deque(maxlen=_LAT_CAP)
@@ -177,7 +184,7 @@ class Dispatcher:
         """Stop the worker; with ``drain`` (default) queued requests are
         served first, otherwise they fail with
         :class:`ServingOverloaded` (``reason="shutdown"``)."""
-        self._drain_on_stop = drain
+        self._drain_on_stop = drain  # racecheck: guarded-by(_stop event ordering)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
@@ -407,7 +414,7 @@ class Dispatcher:
             # stop(drain=False): collect nothing more — still-queued
             # requests fail typed below; the in-flight batch completes
             draining = not (
-                self._stop.is_set() and not getattr(self, "_drain_on_stop", True)
+                self._stop.is_set() and not self._drain_on_stop
             )
             # non-blocking collect while a batch is in flight: the fence
             # must run as soon as there is nothing to stage, not after a
@@ -418,7 +425,7 @@ class Dispatcher:
                 self._resolve(inflight)
             inflight = staged
             if self._stop.is_set() and inflight is None and not batch:
-                if getattr(self, "_drain_on_stop", True):
+                if self._drain_on_stop:
                     if self._carry or not self._q.empty():
                         continue  # keep serving until the backlog is gone
                 else:

@@ -224,8 +224,45 @@ def compare(current: dict, baseline: dict, threshold: float) -> dict:
     }
 
 
-def run(current_path=None, baseline_path=None, threshold=0.10, out_path=None) -> dict:
-    """Library entry (bench.py calls this after writing BENCH_DETAIL.json)."""
+def unchanged_check(current: dict, baseline: dict, pattern: str) -> dict:
+    """Exact-equality guard over DETERMINISTIC fields (ISSUE 12): fields
+    matching ``pattern`` are analytic-model outputs (``*model*`` speedups,
+    planned byte counts, wire ratios) that a pure refactor — e.g. the
+    gate-registry move — must reproduce bit-for-bit; any drift means the
+    refactor changed a plan or a price, not just plumbing. Rows only one
+    side has are skipped (the threshold compare reports those)."""
+    rx = re.compile(pattern)
+    cur_rows, base_rows = _rows_of(current), _rows_of(baseline)
+    mismatches, held = [], 0
+    for row, base_fields in sorted(base_rows.items()):
+        cur_fields = cur_rows.get(row)
+        if cur_fields is None:
+            continue
+        for field, base_val in sorted(base_fields.items()):
+            if not rx.search(field) or not isinstance(base_val, (int, float)):
+                continue
+            cur_val = cur_fields.get(field)
+            if not isinstance(cur_val, (int, float)):
+                continue
+            if cur_val == base_val:
+                held += 1
+            else:
+                mismatches.append(
+                    {"row": row, "field": field, "baseline": base_val, "current": cur_val}
+                )
+    return {
+        "verdict": "moved" if mismatches else "unchanged",
+        "pattern": pattern,
+        "held": held,
+        "mismatches": mismatches,
+    }
+
+
+def run(current_path=None, baseline_path=None, threshold=0.10, out_path=None,
+        unchanged_fields=None) -> dict:
+    """Library entry (bench.py calls this after writing BENCH_DETAIL.json).
+    ``unchanged_fields`` (a regex) additionally runs the exact-equality
+    guard and persists its verdict in the written BENCH_COMPARE.json."""
     current_path = current_path or os.path.join(ROOT, "BENCH_DETAIL.json")
     baseline_path = baseline_path or _latest_round_artifact()
     if baseline_path is None or not os.path.exists(current_path):
@@ -235,9 +272,14 @@ def run(current_path=None, baseline_path=None, threshold=0.10, out_path=None) ->
             "current": current_path,
             "baseline": baseline_path,
         }
-    result = compare(_load(current_path), _load(baseline_path), threshold)
+    current, baseline = _load(current_path), _load(baseline_path)
+    result = compare(current, baseline, threshold)
     result["current_file"] = os.path.relpath(current_path, ROOT)
     result["baseline_file"] = os.path.relpath(baseline_path, ROOT)
+    if unchanged_fields:
+        result["unchanged_fields"] = unchanged_check(
+            current, baseline, unchanged_fields
+        )
     if out_path is None:
         out_path = os.path.join(ROOT, "BENCH_COMPARE.json")
     with open(out_path, "w") as f:
@@ -255,8 +297,20 @@ def main() -> int:
     ap.add_argument(
         "--strict", action="store_true", help="exit 1 on an unflagged regression"
     )
+    ap.add_argument(
+        "--unchanged-fields",
+        default=None,
+        metavar="REGEX",
+        help="additionally require fields matching REGEX to be EXACTLY "
+        "equal between current and baseline (deterministic model fields; "
+        "exit 1 on any drift) — the pure-refactor guard",
+    )
     args = ap.parse_args()
-    result = run(args.current, args.baseline, args.threshold)
+    result = run(
+        args.current, args.baseline, args.threshold,
+        unchanged_fields=args.unchanged_fields,
+    )
+    unchanged = result.get("unchanged_fields")
     # one compact machine-readable line on stdout (details in BENCH_COMPARE.json)
     compact = {
         "verdict": result["verdict"],
@@ -273,7 +327,15 @@ def main() -> int:
         "missing_row": result.get("missing_rows", []),
         "baseline_file": result.get("baseline_file") or result.get("baseline"),
     }
+    if unchanged is not None:
+        compact["unchanged_fields"] = {
+            "verdict": unchanged["verdict"],
+            "held": unchanged["held"],
+            "moved": [f"{m['row']}.{m['field']}" for m in unchanged["mismatches"]],
+        }
     print(json.dumps(compact))
+    if unchanged is not None and unchanged["verdict"] == "moved":
+        return 1
     return 1 if (args.strict and result["verdict"] == "regressed") else 0
 
 

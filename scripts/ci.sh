@@ -20,9 +20,14 @@
 #     default is covered by every other leg running with them off;
 #  4. the multi-chip dryrun: the full training step jit-compiled and
 #     executed on an 8-device mesh (real dp/sp shardings);
-#  5. the bench regression gate, whenever bench artifacts exist
-#     (report-only here: BENCH_COMPARE.json + one verdict line; a
-#     bench-carrying change gates itself via --strict);
+#  5. the bench regression gate, whenever bench artifacts exist:
+#     threshold regressions are report-only (BENCH_COMPARE.json + one
+#     verdict line; a bench-carrying change gates itself via --strict),
+#     but DETERMINISTIC analytic fields (model speedups, pass counts)
+#     HARD-gate via --unchanged-fields (ISSUE 12): they can only move
+#     when a PR intentionally changes pricing, and such a PR must
+#     regenerate its bench artifacts in the same change — the same
+#     update-the-pin rule the golden plan_ids follow;
 #  6. the shardlint legs: source lint over heat_tpu/ (undeclared host
 #     syncs, bare jax.jit, unsanitized public ops) and the IR check of
 #     the __graft_entry__ training step on the 8-device CPU mesh
@@ -140,7 +145,24 @@ HEAT_TPU_OOC=1 python -m pytest tests/test_staging.py tests/test_linalg.py tests
 
 HEAT_TPU_OOC=0 python -m pytest tests/test_staging.py tests/test_linalg.py -q "$@"
 
-python scripts/lint.py heat_tpu/
+python scripts/lint.py heat_tpu/ --pass srclint
+
+# pass-4 leg (ISSUE 12): gatecheck + racecheck over the tree at error
+# severity — gate/cache-key staleness (SL402), raw HEAT_TPU_* reads
+# bypassing the registry (SL403), lock-discipline races in the threaded
+# modules (SL404), and the depth-2 issue/consume protocol (SL405) —
+# plus the SARIF emission CI annotations consume
+python scripts/lint.py heat_tpu/ --pass effectcheck
+python scripts/lint.py heat_tpu/ --pass effectcheck --format sarif > /dev/null
+echo "effectcheck: SL4xx clean + SARIF emitted"
+
+# seeded-bug proof (ISSUE 12 acceptance): each mutation removes ONE
+# invariant — a gate from a program-cache key (SL402: the builder then
+# reads the gate ambiently), a lock acquisition from a guarded
+# dispatcher path (SL404) — and the pass-4 lint must trip at error on
+# the mutated source. tests/test_effectcheck.py runs the same
+# mutations per-rule with the invariant named.
+python -m pytest tests/test_effectcheck.py -q -k "mutation" "$@"
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
   python scripts/lint.py --ir-entry 8
@@ -175,5 +197,9 @@ done
 rm -f "$plans_a" "$plans_b"
 
 if [ -f BENCH_DETAIL.json ] && ls BENCH_r*.json >/dev/null 2>&1; then
-  python scripts/bench_compare.py
+  # the regex holds every DETERMINISTIC analytic field
+  # (model_speedup, tier_model_speedup, stage_model_gbps, ...) to exact
+  # equality: a pure refactor — the ISSUE 12 gate-registry move — must
+  # prove it shifted zero bench numbers, not just stayed in threshold
+  python scripts/bench_compare.py --unchanged-fields 'model|passes_over_A|_algorithmic'
 fi

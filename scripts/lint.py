@@ -14,6 +14,17 @@ Source lint (pass 2)::
   ops). Exit 1 iff an error-severity finding gates; warnings report
   only.
 
+Effect lint (pass 4) over the same tree::
+
+    python scripts/lint.py heat_tpu/ --pass effectcheck
+
+  The ``gatecheck``/``racecheck`` rules (SL4xx): gate/cache-key
+  staleness against the ``heat_tpu.core.gates`` registry, raw
+  ``HEAT_TPU_*`` env reads bypassing it, lock-discipline races in the
+  threaded modules, and the depth-2 issue/consume pipeline protocol.
+  ``--pass all`` (the default when paths are given) runs passes 2 and 4
+  together.
+
 IR lint (pass 1) over the driver training step::
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
@@ -122,6 +133,16 @@ def main() -> int:
         "on an N-device mesh (pass 1)",
     )
     ap.add_argument(
+        "--pass",
+        dest="which",
+        choices=("srclint", "effectcheck", "all"),
+        default="all",
+        help="which source passes to run over the given paths: pass 2 "
+        "(srclint, SL2xx), pass 4 (effectcheck, SL4xx: gate/cache-key "
+        "staleness, raw gate reads, lock discipline, pipeline protocol), "
+        "or both (default)",
+    )
+    ap.add_argument(
         "--format",
         choices=("text", "json", "sarif"),
         default=None,
@@ -138,12 +159,20 @@ def main() -> int:
 
     gate = False
     reports = []
-    if args.paths:
+    if args.paths and args.which in ("srclint", "all"):
         from heat_tpu.analysis import srclint
 
         report = srclint.lint_paths(args.paths, root=ROOT)
         _print_report(report, "srclint", fmt)
         reports.append(("srclint", report))
+        gate |= not report.ok
+
+    if args.paths and args.which in ("effectcheck", "all"):
+        from heat_tpu.analysis import effectcheck
+
+        report = effectcheck.lint_paths(args.paths, root=ROOT)
+        _print_report(report, "effectcheck", fmt)
+        reports.append(("effectcheck", report))
         gate |= not report.ok
 
     if args.ir_entry is not None:

@@ -184,6 +184,169 @@ def replicated_liverange_program(x):
     return g * 1.0 + y
 
 
+# --------------------------------------------------------------------- #
+# pass 4 (ISSUE 12): gatecheck + racecheck golden bad fixtures           #
+# --------------------------------------------------------------------- #
+_donating_double = None
+
+
+def use_after_donate_program(x):
+    """SL401: the inner program DONATES its operand (ht.jit
+    donate_argnums — resolved through the shared analysis/_donation.py
+    resolver), and the caller then reads the donated array again. The
+    donating program may already have overwritten the buffer in place;
+    on hardware the second read returns garbage nondeterministically,
+    which is exactly why the rule is static (jaxpr dataflow: the
+    donated invar is dead past the pjit equation that donates it)."""
+    global _donating_double
+    if _donating_double is None:
+        _donating_double = ht.jit(lambda a: a * 2.0, donate_argnums=0)
+    y = _donating_double(x)
+    return y + x  # x's buffer was donated one line up
+
+
+def donate_then_done_program(x):
+    """Clean twin of ``use_after_donate_program``: same donating inner
+    call, but the donated operand is never touched again."""
+    global _donating_double
+    if _donating_double is None:
+        _donating_double = ht.jit(lambda a: a * 2.0, donate_argnums=0)
+    return _donating_double(x) + 1.0
+
+
+#: SL402 (lru arm): a cached program builder that resolves the overlap
+#: gate INSIDE its body — the cache key (the parameters) no longer
+#: carries the gate, so a HEAT_TPU_REDIST_OVERLAP flip keeps serving
+#: the program compiled under the old value. The fix the finding names:
+#: resolve at the caller, pass `pipelined` as a parameter (exactly what
+#: redistribution/executor.py does).
+STALE_KEY_BUILDER_SRC = '''
+import functools
+
+from heat_tpu.redistribution.planner import overlap_mode
+
+
+@functools.lru_cache(maxsize=512)
+def _move_program(comm, spec, budget):
+    pipelined = overlap_mode() != "0"   # ambient read under the cache
+    return (comm, spec, budget, pipelined)
+'''
+
+#: SL402 (dict arm): a plan cache whose key tuple DROPS the resolved
+#: topology — the planner's own `key = (spec, b, qmode, topo)` with one
+#: component deleted, the exact omission class the PR 9/10 hardening
+#: lists kept catching by review.
+STALE_DICT_KEY_SRC = '''
+_plan_cache = {}
+
+
+def wire_quant_gate():
+    return None
+
+
+def resolve_topology(n):
+    return None
+
+
+def plan(spec, budget):
+    qmode = wire_quant_gate()
+    topo = resolve_topology(8)
+    key = (spec, budget, qmode or "0")   # topo missing from the key
+    cached = _plan_cache.get(key)
+    if cached is not None:
+        return cached
+    _plan_cache[key] = spec
+    return spec
+'''
+
+#: SL403: raw HEAT_TPU_* reads bypassing the registry — a literal get,
+#: the hand-rolled fingerprint enumeration, and a containment probe.
+RAW_GATE_READ_SRC = '''
+import os
+
+
+def read_gate():
+    return os.environ.get("HEAT_TPU_REDIST_OVERLAP", "auto")
+
+
+def fingerprint():
+    return sorted(k for k in os.environ.keys() if k.startswith("HEAT_TPU_"))
+
+
+def probe():
+    return "HEAT_TPU_OOC" in os.environ
+'''
+
+#: SL404: the dispatcher's shape with the counts lock MISSING on the
+#: client path — the worker mutates under the lock, stats() reads bare.
+UNGUARDED_ATTR_SRC = '''
+import threading
+
+
+class BadDispatcher:
+    def __init__(self):
+        self._counts_lock = threading.Lock()
+        self._counts = {"batches": 0}
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        with self._counts_lock:
+            self._counts["batches"] += 1
+
+    def stats(self):
+        return dict(self._counts)   # client read, no lock
+'''
+
+#: SL405: three broken depth-2 skeletons — the inverted loop (consume
+#: lap k before issuing lap k+1), the unfenced read (consuming the lap
+#: it JUST issued), and the dropped final lap — plus the correct
+#: rotation (`good_laps`, the executor's `_run_laps` shape) as the
+#: clean pin.
+PIPELINE_PROTOCOL_SRC = '''
+def inverted_laps(indices, issue, consume, state):
+    idx = list(indices)
+    prev = issue(idx[0])
+    for i in range(1, len(idx)):
+        state = consume(state, prev, idx[i - 1])   # consume BEFORE issue
+        prev = issue(idx[i])
+    return consume(state, prev, idx[-1])
+
+
+def unfenced_laps(indices, issue, consume, state):
+    idx = list(indices)
+    prev = issue(idx[0])
+    for i in range(1, len(idx)):
+        nxt = issue(idx[i])
+        state = consume(state, nxt, idx[i])        # consumes the in-flight lap
+        prev = nxt
+    return consume(state, prev, idx[-1])
+
+
+def dropped_lap(indices, issue, consume, state):
+    idx = list(indices)
+    prev = issue(idx[0])
+    for i in range(1, len(idx)):
+        nxt = issue(idx[i])
+        state = consume(state, prev, idx[i - 1])
+        prev = nxt
+    return state                                    # final prefetch dropped
+
+
+def good_laps(indices, issue, consume, state):
+    idx = list(indices)
+    prev = issue(idx[0])
+    for i in range(1, len(idx)):
+        nxt = issue(idx[i])
+        state = consume(state, prev, idx[i - 1])
+        prev = nxt
+    return consume(state, prev, idx[-1])
+'''
+
+
 def serving_sync_handler(x):
     """SL106 (ISSUE 9): a serving request handler that reads device
     VALUES on the host mid-request — a debug/logging sync buried in the

@@ -493,8 +493,11 @@ class TestLintCLI(TestCase):
         self.assertEqual(ok.returncode, 0, ok.stdout + ok.stderr)
         doc = json.loads(ok.stdout)
         self.assertEqual(doc["version"], "2.1.0")
-        self.assertEqual(len(doc["runs"]), 1)  # one run per pass
-        self.assertEqual(doc["runs"][0]["tool"]["driver"]["name"], "shardlint/srclint")
+        # one run per pass — the default runs pass 2 AND pass 4 (ISSUE 12)
+        self.assertEqual(
+            [run["tool"]["driver"]["name"] for run in doc["runs"]],
+            ["shardlint/srclint", "shardlint/effectcheck"],
+        )
         import tempfile
 
         with tempfile.TemporaryDirectory() as td:
