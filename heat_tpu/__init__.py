@@ -47,6 +47,7 @@ from . import optim
 from . import preprocessing
 from . import redistribution
 from . import regression
+from . import resilience
 from . import serving
 from . import sparse
 from . import spatial

@@ -52,6 +52,9 @@ __all__ = [
 HOST_MODULES: Tuple[str, ...] = (
     "core/io.py",       # save/load: hyperslab writes are host-side by nature
     "core/printing.py", # __str__ formatting renders on the host
+    # checkpointing IS host I/O: durable state must cross to the host
+    # to reach the persistent store (slab-streamed, ISSUE 13)
+    "resilience/checkpoint.py",
 )
 
 # (path suffix, qualname) -> reason. Host-value producers/ingesters.
@@ -118,6 +121,32 @@ HOST_BOUNDARIES: Dict[str, Tuple[str, str, str]] = {
         "the completion fence for each timed probe (block_until_ready is "
         "a no-op over the remote tunnel — bench.py methodology). Runs "
         "only eagerly on TPU, never inside a trace",
+    ),
+    "optimizer-checkpoint-export": (
+        "optim/dp_optimizer.py",
+        "DataParallelOptimizer.checkpoint_state",
+        "checkpoint export IS host transfer by contract (ISSUE 13): the "
+        "base PRNG key crosses to the host so the resilience envelope "
+        "can persist it; the array leaves stream through the checkpoint "
+        "module's own slab writers (a declared host module)",
+    ),
+    "optimizer-checkpoint-restore": (
+        "optim/dp_optimizer.py",
+        "DataParallelOptimizer.load_checkpoint_state",
+        "checkpoint restore's world-resize fold: the restored EF carry "
+        "is folded row-wise on the host (r -> r % p_new, sum-preserving) "
+        "before re-sharding onto the survivors — an eager, "
+        "recovery-path-only transfer",
+    ),
+    "resilience-state-validate": (
+        "resilience/elastic.py",
+        "_finite_state",
+        "the poisoned-collective detector of the elastic streaming loop "
+        "(ISSUE 13): after each window update the (k, d) centers — a "
+        "scalar-class array — are read to the host and checked finite; "
+        "the read IS the detection, and it only runs when the elastic "
+        "runtime is engaged (a ckpt/watcher/chaos hook was handed in), "
+        "never on the default or HEAT_TPU_RESILIENCE=0 paths",
     ),
     "relayout-autotune-sync": (
         "kernels/relayout.py",

@@ -35,6 +35,16 @@ individual program is correct:
   lock-free designs are declared, reviewably, with
   ``# racecheck: guarded-by(<what>) -- reason`` on any access (or
   ``__init__`` assignment) line of the attribute.
+- **SL406 swallowed-worker-exception** — over the same threaded
+  classes: a worker-path ``except Exception`` (or bare ``except``)
+  whose handler neither re-raises, nor resolves a future
+  (``set_exception``/``set_result`` — directly or via an intra-class
+  helper that does), nor forwards the caught object into any call (the
+  queue-forwarding idiom). That silent-swallow shape is exactly what a
+  failover path must never have: the client's future never resolves
+  and the failure becomes a hang (ISSUE 13 — added alongside the
+  dispatcher's drain path, whose handlers all fail their owned futures
+  typed and are pinned clean).
 - **SL405 pipeline-protocol** — the depth-2 double-buffer skeletons
   (``executor._run_laps``, ``staging.stream_windows``, and anything
   shaped like them): a loop that claims depth 2 (prologue prefetch of
@@ -819,6 +829,169 @@ def _lint_sl404(tree: ast.Module, rel: str, pragmas, guards: Dict[int, str]) -> 
 
 
 # --------------------------------------------------------------------- #
+# SL406 — swallowed worker exceptions (the failover-path hazard)        #
+# --------------------------------------------------------------------- #
+_BROAD_EXC = frozenset({"Exception", "BaseException"})
+_RESOLVERS = frozenset({"set_exception", "set_result"})
+
+
+def _catches_broad(handler: ast.ExceptHandler) -> bool:
+    """Does the handler catch Exception/BaseException or everything?"""
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        name = node.attr if isinstance(node, ast.Attribute) else getattr(node, "id", None)
+        if name in _BROAD_EXC:
+            return True
+    return False
+
+
+#: sinks that FORMAT an exception instead of delivering it: passing the
+#: caught object to a logger or print is exactly the log-and-continue
+#: swallow the rule exists to catch — the object reaches an operator's
+#: eyes (maybe), never the waiting client.
+_LOGGING_SINKS = frozenset({
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log", "print",
+})
+
+
+def _is_logging_call(node: ast.Call) -> bool:
+    if isinstance(node.func, ast.Name):
+        return node.func.id in _LOGGING_SINKS
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr in _LOGGING_SINKS
+    return False
+
+
+def _resolves_or_forwards(body: List[ast.stmt], exc_name: Optional[str]) -> bool:
+    """Does a handler body surface the failure? — a re-``raise``, a
+    future resolution (``.set_exception``/``.set_result``), or the
+    caught exception object forwarded into a NON-LOGGING call (the
+    partial-dataset queue-forwarding idiom). Passing the object to a
+    logger/``print`` does NOT count: log-and-continue is the flagship
+    swallow — the client's future still never resolves."""
+    for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _RESOLVERS
+            ):
+                return True
+            if exc_name is not None and not _is_logging_call(node):
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                for a in args:
+                    if any(
+                        isinstance(n, ast.Name) and n.id == exc_name
+                        for n in ast.walk(a)
+                    ):
+                        return True
+    return False
+
+
+def _direct_resolver_methods(methods: Dict[str, ast.FunctionDef]) -> Set[str]:
+    """Class methods whose body itself resolves futures or raises —
+    calling one of these from a handler surfaces the failure (the
+    dispatcher's ``_fail_queued`` shape)."""
+    out: Set[str] = set()
+    for name, m in methods.items():
+        for node in ast.walk(m):
+            if isinstance(node, ast.Raise):
+                out.add(name)
+                break
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _RESOLVERS
+            ):
+                out.add(name)
+                break
+    return out
+
+
+def _lint_sl406(tree: ast.Module, rel: str, pragmas) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        methods = {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
+        if not methods:
+            continue
+        # worker roots + intra-class call closure (the SL404 discovery)
+        worker_roots: Set[str] = set()
+        call_edges: Dict[str, Set[str]] = {}
+        for m in methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call) and _call_name(node.func) == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target" and _self_attr(kw.value) in methods:
+                            worker_roots.add(_self_attr(kw.value))
+                    for a in node.args:
+                        if _self_attr(a) in methods:
+                            worker_roots.add(_self_attr(a))
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in methods
+                ):
+                    call_edges.setdefault(m.name, set()).add(node.func.attr)
+        if not worker_roots:
+            continue
+        worker = _closure(worker_roots, call_edges)
+        resolvers = _direct_resolver_methods(methods)
+        for name in sorted(worker):
+            method = methods.get(name)
+            if method is None:
+                continue
+            for node in ast.walk(method):
+                if not isinstance(node, ast.Try):
+                    continue
+                for h in node.handlers:
+                    if not _catches_broad(h):
+                        continue
+                    if _resolves_or_forwards(h.body, h.name):
+                        continue
+                    # one level of intra-class indirection: a handler
+                    # delegating to a method that itself resolves/raises
+                    # (the dispatcher's _fail_queued shape) is surfaced
+                    called = {
+                        n.func.attr
+                        for n in ast.walk(ast.Module(body=h.body, type_ignores=[]))
+                        if isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "self"
+                    }
+                    if called & resolvers:
+                        continue
+                    scope = _Scope((cls.name, name), (cls.lineno, method.lineno))
+                    if _suppressed("SL406", h.lineno, scope, pragmas):
+                        continue
+                    findings.append(
+                        Finding(
+                            "SL406",
+                            "error",
+                            f"swallowed worker exception in {cls.name}.{name}: "
+                            "the worker-thread path catches "
+                            f"{'everything' if h.type is None else 'Exception'} "
+                            "and neither re-raises, resolves a future "
+                            "(set_exception/set_result), nor forwards the "
+                            "caught object — a failover path that swallows "
+                            "its failure turns it into a client-side hang; "
+                            "fail the owned futures typed, or forward the "
+                            "exception to the consumer",
+                            path=rel,
+                            line=h.lineno,
+                        )
+                    )
+    return findings
+
+
+# --------------------------------------------------------------------- #
 # SL405 — pipeline-protocol (issue/consume ordering)                    #
 # --------------------------------------------------------------------- #
 def _flat_stmts(body: List[ast.stmt]) -> List[Tuple[ast.stmt, bool]]:
@@ -958,6 +1131,7 @@ def lint_source(src: str, rel: str) -> List[Finding]:
     findings += _lint_sl402(tree, rel, pragmas)
     findings += _lint_sl404(tree, rel, pragmas, guards)
     findings += _lint_sl405(tree, rel, pragmas)
+    findings += _lint_sl406(tree, rel, pragmas)
     findings.sort(key=lambda f: (f.path or "", f.line or 0, f.rule))
     return findings
 

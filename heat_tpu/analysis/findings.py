@@ -94,6 +94,12 @@ RULES: Dict[str, str] = {
              "issuing lap k+1, consumes the lap it just issued, or drops "
              "the final carried lap — the overlap the plan's annotation "
              "promises never happens (or reads an unfenced buffer)",
+    "SL406": "swallowed-worker-exception: a worker-thread path catches "
+             "Exception (or everything) without re-raising, resolving a "
+             "future (set_exception/set_result), or forwarding the caught "
+             "object — the silent-swallow shape that turns a failover "
+             "path's error into a hang: the client's future never "
+             "resolves and no supervisor ever hears about the failure",
 }
 
 

@@ -203,8 +203,52 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         self._inertia = None
         self._n_iter = None
 
-        if random_state is not None:
-            ht_random.seed(random_state)
+        # ISSUE 13 satellite — seed/stream state is EXPLICIT MODEL
+        # state. The old contract ("the ctor reseeds the GLOBAL stream,
+        # every init advances it") meant two same-seed models created
+        # then fitted in sequence drew DIFFERENT inits, and a
+        # checkpoint could not capture "where this model's stream is".
+        # New contract: ``random_state`` establishes a model-PRIVATE
+        # (seed, counter=0) stream; only this model's inits advance it,
+        # the global stream is never touched. Two same-seed models —
+        # fresh or restored from the same checkpoint — therefore draw
+        # identical inits. A model WITHOUT a random_state keeps the
+        # legacy global-stream draws (``_rng_state is None``).
+        # Single-model seeded results are unchanged: the first init
+        # still draws from (seed, counter=0) exactly as before.
+        self._rng_state = (
+            None if random_state is None
+            else ("Threefry", int(random_state), 0, 0, 0.0)
+        )
+
+    def _with_stream(self, fn):
+        """Run ``fn()`` against the model's private RNG stream when one
+        exists (``random_state`` given, or restored from a checkpoint),
+        else against the global stream (legacy). The private stream is
+        swapped into the global slot for the draw and the ADVANCED
+        state captured back — so ``_seed_key``/``randperm`` derivations
+        stay byte-identical to the pre-satellite code at equal
+        (seed, counter), and the outer global stream is untouched."""
+        if self._rng_state is None:
+            return fn()
+        outer = ht_random.get_state()
+        ht_random.set_state(self._rng_state)
+        try:
+            return fn()
+        finally:
+            self._rng_state = ht_random.get_state()
+            ht_random.set_state(outer)
+
+    @property
+    def rng_state(self):
+        """The model's explicit RNG stream state — ``("Threefry", seed,
+        counter, 0, 0.0)`` for seeded models (checkpoint material), or
+        ``None`` for models on the legacy global stream."""
+        return self._rng_state
+
+    @rng_state.setter
+    def rng_state(self, state) -> None:
+        self._rng_state = None if state is None else tuple(state)
 
     @property
     def cluster_centers_(self) -> DNDarray:
@@ -255,7 +299,9 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         elif isinstance(self.init, str) and self.init == "random":
             # k observations drawn at random from the data (reference:
             # per-centroid rank-owned row + Bcast; here a global gather)
-            idx = ht_random.randperm(n, comm=x.comm).larray[:k]
+            idx = self._with_stream(
+                lambda: ht_random.randperm(n, comm=x.comm).larray[:k]
+            )
             centers = jnp.take(arr, idx, axis=0)
         elif isinstance(self.init, str) and self.init in ("probability_based", "kmeans++", "k-means++"):
             centers = self._kmeanspp(arr, k)
@@ -282,7 +328,7 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         cost ~20 dispatches, each a millisecond-class round trip over the
         remote execution tunnel)."""
         prog = _kmeanspp_program(k, tuple(arr.shape), np.dtype(arr.dtype).name)
-        return prog(arr, _seed_key(k))
+        return prog(arr, self._with_stream(lambda: _seed_key(k)))
 
     # ------------------------------------------------------------------ #
     # assignment (reference: _kcluster.py:196-209)                       #
@@ -351,7 +397,7 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         if seeded:
             # the SHARED derivation keeps seeded results identical between
             # the fused fit and the composite _kmeanspp path
-            init_arg = _seed_key(k)
+            init_arg = self._with_stream(lambda: _seed_key(k))
         else:
             self._initialize_cluster_centers(x)
             init_arg = self._cluster_centers.larray

@@ -180,7 +180,7 @@ class KMeans(_KCluster):
             x.comm,
         )
 
-    def fit(self, x) -> "KMeans":
+    def fit(self, x, ckpt=None, _watcher=None, _chaos=None) -> "KMeans":
         """Run Lloyd iterations to convergence (reference: kmeans.py:102).
         Seeding, the convergence while_loop and the final assignment run
         as ONE compiled program — a single dispatch per fit (see
@@ -192,21 +192,52 @@ class KMeans(_KCluster):
         documented streaming-k-means algorithm; ``labels_`` stays unset
         — call :meth:`predict` batch-wise). With ``HEAT_TPU_OOC=0`` a
         fitting host operand materializes whole and runs the exact
-        in-HBM Lloyd fit instead."""
+        in-HBM Lloyd fit instead.
+
+        ``ckpt`` (ISSUE 13, streaming path only): a
+        ``ht.resilience.CheckpointConfig`` — the window stream commits
+        a checkpoint every ``ckpt.every`` windows (centers, counts, the
+        explicit RNG stream state, the window cursor and the slab the
+        windows derive from) and, when a committed checkpoint for
+        ``ckpt.tag`` already exists, RESUMES from it: the remaining
+        windows replay with the recorded slab, so the resumed fit is
+        bit-identical to an uninterrupted one — on the original world
+        or a re-resolved (shrunk/grown) one. ``_watcher``/``_chaos``
+        are the elastic runtime's hooks (``ht.resilience.elastic_fit``
+        drives them); with ``HEAT_TPU_RESILIENCE=0`` a ``ckpt`` is
+        ignored EVERYWHERE — including the unstreamable-input errors
+        below, which only fire when the runtime is live — and the exact
+        pre-resilience paths run."""
         from ..redistribution import staging as _staging
 
+        if ckpt is not None:
+            from ..resilience import checkpoint as _ckpt_mod
+
+            if not _ckpt_mod.resilience_enabled(explicit=True):
+                ckpt = None  # the documented escape hatch: ckpt is inert
         if isinstance(x, _staging.HostArray):
             if not _staging.ooc_engaged(x.nbytes, host_resident=True):
+                if ckpt is not None:
+                    raise ValueError(
+                        "KMeans.fit(ckpt=): checkpointed resume rides the "
+                        "streaming window path, which HEAT_TPU_OOC=0 "
+                        "disables — unset the gate or drop ckpt="
+                    )
                 return self._fit_fused(
                     _staging.materialize(x, what="KMeans.fit"),
                     _lloyd_step,
                     returns_inertia=True,
                 )
-            # fit() is a FRESH fit: drop any previous streaming state
-            # (partial_fit is the API that continues a stream)
-            self._cluster_centers = None
-            self._partial_counts = None
-            return self._partial_fit_stream(x)
+            return self._partial_fit_stream(
+                x, ckpt=ckpt, watcher=_watcher, chaos=_chaos, fresh=True
+            )
+        if ckpt is not None:
+            raise ValueError(
+                "KMeans.fit(ckpt=): the fused in-HBM Lloyd fit runs as ONE "
+                "device program with no host cut points to checkpoint at — "
+                "stream a staging.HostArray (or drive partial_fit batches) "
+                "to checkpoint mid-fit"
+            )
         return self._fit_fused(x, _lloyd_step, returns_inertia=True)
 
     # ------------------------------------------------------------------ #
@@ -262,28 +293,166 @@ class KMeans(_KCluster):
         )
         return self
 
-    def _partial_fit_stream(self, host) -> "KMeans":
+    def _partial_fit_stream(self, host, ckpt=None, watcher=None, chaos=None,
+                            fresh: bool = False) -> "KMeans":
         """One epoch of ``partial_fit`` windows over a host-resident
         operand: the window schedule is planned as a ``host-staging``
         Schedule (axis-0 windows), PROVEN to fit ``capacity("hbm")``,
         and executed depth-2 double-buffered — window k+1's
-        ``device_put`` rides under window k's fused update."""
+        ``device_put`` rides under window k's fused update.
+
+        The elastic hooks (ISSUE 13, all optional and ALL inert under
+        ``HEAT_TPU_RESILIENCE=0`` — the gate governs every hook, not
+        just checkpointing, so the escape hatch runs the exact
+        pre-resilience stream): ``ckpt`` commits/resumes the window
+        cursor + model state; ``watcher`` is polled after each window
+        (a world change raises the typed ``WorldChangedError``);
+        ``chaos`` injects the declared faults. Poisoned state is
+        caught by the finite-state validation AT COMMIT CADENCE — a
+        host sync per window would pay the ~90 ms tunnel round trip
+        the codebase optimizes away; validating immediately before
+        each save preserves the invariant that matters (poisoned state
+        is never COMMITTED: restore lands behind the poisoned window
+        and replays it clean)."""
         from ..core import factories
         from ..redistribution import staging as _staging
+        from ..resilience import checkpoint as _ckpt_mod, elastic as _elastic
 
+        enabled_rt = _ckpt_mod.resilience_enabled(
+            explicit=ckpt is not None or watcher is not None or chaos is not None
+        )
+        engaged = enabled_rt and ckpt is not None
+        guarded = enabled_rt and (
+            engaged or watcher is not None or chaos is not None
+        )
+        start = 0
+        slab_override = None
+        if fresh:
+            # fit() is a FRESH fit: drop any previous streaming state
+            # (partial_fit is the API that continues a stream)
+            self._cluster_centers = None
+            self._partial_counts = None
+        if engaged:
+            found = _ckpt_mod.restore_latest(ckpt.directory, tag=ckpt.tag)
+            if found is not None:
+                _step, state, _meta = found
+                saved_shape = state.get("host_shape")
+                if saved_shape is not None and (
+                    tuple(saved_shape) != tuple(host.shape)
+                    or str(state.get("host_dtype")) != str(host.dtype)
+                ):
+                    raise ValueError(
+                        f"checkpoint tag {ckpt.tag!r} was written for a "
+                        f"{tuple(saved_shape)}/{state.get('host_dtype')} "
+                        f"operand but this fit streams {host.shape}/"
+                        f"{host.dtype} — resuming would adopt another "
+                        "dataset's cursor; use a fresh tag"
+                    )
+                self._load_stream_state(state)
+                start = int(state["window_index"])
+                slab_override = int(state["slab_bytes"])
         sched = _staging.plan_staged_passes(
             host.shape,
             host.dtype,
             [{"tag": "partial-fit", "axis": 0}],
             out_bytes=self.n_clusters * host.shape[1] * 8 + (1 << 20),
+            slab=slab_override,
         )
         _staging.prove_fits(sched)
-        wins = _staging.window_extents(
-            host.shape, host.dtype.itemsize, 0, int(sched.staging["slab_bytes"])
-        )
+        slab = int(sched.staging["slab_bytes"])
+        wins = _staging.window_extents(host.shape, host.dtype.itemsize, 0, slab)
+        n_win = len(wins)
+        if start >= n_win:
+            return self  # the committed checkpoint already covers the epoch
+        put = None
+        if guarded and chaos is not None:
+            chaos.bind_offset(start)
+            put = chaos.poison_put()
 
-        def consume(k, slab_arr, win):
+        def _validate(k):
+            if not _elastic._finite_state(self):
+                raise _elastic.CollectivePoisoned(
+                    f"window {k}: non-finite centers after the update — "
+                    "poisoned exchange; restore from the last committed "
+                    "checkpoint and replay"
+                )
+
+        def consume(j, slab_arr, win):
+            k = start + j
             self._partial_fit_batch(factories.array(slab_arr, split=None))
+            if not guarded:
+                return
+            if engaged and ((k + 1) % ckpt.every == 0 or k == n_win - 1):
+                _validate(k)  # never COMMIT poisoned state
+                path = _ckpt_mod.save(
+                    self._stream_checkpoint_state(k + 1, slab, host),
+                    tag=ckpt.tag, step=k + 1, directory=ckpt.directory,
+                )
+                _ckpt_mod.prune(ckpt.directory, ckpt.tag, ckpt.keep)
+                if chaos is not None:
+                    chaos.after_checkpoint(path, k + 1)
+            elif chaos is not None:
+                # chaos without checkpoints (drills/tests): detect at
+                # every window — there is no commit cadence to ride
+                _validate(k)
+            if watcher is not None:
+                evt = watcher.poll(k)
+                if evt is not None:
+                    raise _elastic.WorldChangedError(
+                        evt.kind,
+                        old_size=evt.detail.get("old_size"),
+                        new_size=len(evt.devices),
+                        epoch=_elastic.world_epoch(),
+                    )
 
-        _staging.stream_windows(host, 0, wins, consume)
+        rng0 = self._rng_state
+        try:
+            _staging.stream_windows(host, 0, wins[start:], consume, device_put=put)
+        except BaseException:
+            if guarded:
+                # a failed guarded stream rewinds the model's private
+                # stream to where THIS attempt started: a retry with no
+                # committed checkpoint then re-inits IDENTICALLY (when a
+                # checkpoint exists, restore overwrites the stream
+                # anyway) — the bit-reproducible-resume contract holds
+                # even for failures before the first commit
+                self._rng_state = rng0
+            raise
         return self
+
+    # -- checkpoint material (ISSUE 13) -------------------------------- #
+    def _stream_checkpoint_state(self, window_index: int, slab_bytes: int,
+                                 host) -> dict:
+        """What a mid-stream checkpoint must capture to resume
+        bit-reproducibly: centers, running counts, the EXPLICIT RNG
+        stream state, the window cursor + slab the window geometry
+        derives from (a resumed stream must replay the SAME windows —
+        the running-mean update is batch-boundary dependent), and the
+        OPERAND IDENTITY (shape/dtype) so a same-tag resume against a
+        different dataset fails typed instead of adopting a foreign
+        cursor."""
+        state = {
+            "centers": self._cluster_centers,
+            "rng_state": self._rng_state,
+            "window_index": int(window_index),
+            "slab_bytes": int(slab_bytes),
+            "n_clusters": int(self.n_clusters),
+            "host_shape": [int(s) for s in host.shape],
+            "host_dtype": str(host.dtype),
+        }
+        if self._partial_counts is not None:
+            state["counts"] = self._partial_counts
+        return state
+
+    def _load_stream_state(self, state: dict) -> None:
+        """Adopt a restored checkpoint's model state — the arrays
+        arrive already re-sharded onto the CURRENT world."""
+        if int(state.get("n_clusters", self.n_clusters)) != self.n_clusters:
+            raise ValueError(
+                f"checkpoint carries n_clusters={state.get('n_clusters')} "
+                f"but this model has {self.n_clusters}"
+            )
+        self._cluster_centers = state["centers"]
+        self._partial_counts = state.get("counts")
+        rng = state.get("rng_state")
+        self._rng_state = tuple(rng) if rng is not None else None

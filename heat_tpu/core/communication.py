@@ -297,7 +297,11 @@ class MeshCommunication(Communication):
     this model.
     """
 
-    __slots__ = ("_devices_", "_mesh", "axis_name", "_self_like")
+    # _ht_epoch: the elastic runtime's world-epoch stamp (ISSUE 13,
+    # heat_tpu.resilience.elastic) — set only on communicators the
+    # runtime binds; unset on every other comm, so the executor's
+    # fence stays a getattr-default no-op
+    __slots__ = ("_devices_", "_mesh", "axis_name", "_self_like", "_ht_epoch")
 
     def __init__(self, devices=None, axis_name: str = "d"):
         # device resolution is LAZY when no explicit devices are given:

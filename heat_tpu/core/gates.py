@@ -319,6 +319,34 @@ declare(GateSpec(
     help="telemetry registry switch — records host-side values only, "
          "changes no program bytes",
 ))
+declare(GateSpec(
+    "HEAT_TPU_RESILIENCE", default="auto", values=("0", "1", "auto"),
+    affects_programs=True, scopes=("aot",),
+    key_params=(),
+    accessors=("resilience_mode", "resilience_enabled"),
+    help="elastic fault-tolerant runtime switch (heat_tpu.resilience): "
+         "0 = exact pre-resilience paths everywhere (escape hatch — no "
+         "checkpoint hooks, no world-epoch guards, no drain fences), "
+         "1 = force (the chaos CI leg), auto = engage where the caller "
+         "hands the runtime a checkpoint config or watcher. "
+         "Conservatively program-affecting: the elastic runtime re-enters "
+         "cached programs across world re-resolutions under the epoch "
+         "discipline this gate installs, and AOT envelopes exported "
+         "before the resilience runtime predate the restore contract's "
+         "world re-binding — the roster bump (version_mismatch for "
+         "pre-resilience envelopes) is the designed invalidation",
+))
+declare(GateSpec(
+    "HEAT_TPU_CKPT_DIR", default="~/.cache/heat_tpu/ckpt", kind="path",
+    affects_programs=False, scopes=(),
+    key_params=(),
+    accessors=("ckpt_dir",),
+    help="checkpoint store root (heat_tpu.resilience.checkpoint). TRUST "
+         "BOUNDARY like the AOT store: envelopes are integrity-checked "
+         "(per-entry sha256) but restore unpickles nothing — still, the "
+         "directory must carry the same write permissions as the "
+         "deployment's code. A path, never program-bytes key material",
+))
 
 
 # --------------------------------------------------------------------- #

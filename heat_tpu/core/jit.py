@@ -92,6 +92,28 @@ def aot_hooks():
     return _AOT_HOOKS
 
 
+# every live ht.jit wrapper, so the elastic runtime's eviction sweep
+# (heat_tpu.resilience.elastic.invalidate_caches) can drop program
+# entries compiled against a world that no longer exists. Entries are
+# keyed on comm IDENTITY (_DndSpec), so a re-resolved world can never
+# HIT a stale entry — the sweep reclaims the memory.
+import weakref
+
+_LIVE_WRAPPERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def clear_wrapper_caches() -> int:
+    """Drop every live ``ht.jit`` wrapper's program cache; returns the
+    total number of evicted entries."""
+    n = 0
+    for w in list(_LIVE_WRAPPERS):
+        cache = getattr(w, "_ht_jit_cache", None)
+        if cache:
+            n += len(cache)
+            cache.clear()
+    return n
+
+
 def _is_leaf(x) -> bool:
     return isinstance(x, DNDarray)
 
@@ -508,4 +530,5 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
     # donation bookkeeping for ht.analysis.check (rule SL105): which
     # user-visible positional args this wrapper donates at dispatch
     wrapper._ht_jit_donate_argnums = donate_user
+    _LIVE_WRAPPERS.add(wrapper)
     return wrapper

@@ -51,6 +51,7 @@ __all__ = [
     "DEFAULT_HBM_BYTES",
     "DEFAULT_HOST_BYTES",
     "DEFAULT_VMEM_BYTES",
+    "DISK_BPS",
     "EDGES",
     "HBM_BPS",
     "HBM_ENV",
@@ -104,14 +105,27 @@ ICI_BPS = 200e9
 #: analytic-model + HLO-census methodology (PR 8).
 DCN_BPS = 25e9
 
+#: host↔persistent-store bandwidth for a DURABLE commit (ISSUE 13: the
+#: checkpoint writer's edge). Deliberately the fsync-inclusive figure —
+#: ~0.8 GB/s is what a single-stream persistent-disk-class store (PD /
+#: network filesystem) sustains once the commit protocol (write, fsync,
+#: rename) is counted; raw NVMe page-cache streaming reaches 3+ GB/s
+#: but a checkpoint is only as durable as its fsync, so pricing the
+#: cache-speed figure would make every recovery-time budget optimistic
+#: by ~4x. The ROADMAP disk-tier item tracks the NVMe streaming figure
+#: separately for non-durable staging reads (``HostArray.from_hdf5``).
+DISK_BPS = 0.8e9
+
 #: edge name -> (near tier, far tier, bytes/s). Edge names are what
 #: ``Step.tier`` carries in the Schedule IR ("ici"/"dcn" since PR 8,
-#: "pcie" for the staging steps of ISSUE 11).
+#: "pcie" for the staging steps of ISSUE 11; "disk" prices the
+#: checkpoint commit path of ISSUE 13).
 EDGES: Dict[str, Tuple[str, str, float]] = {
     "hbm": ("vmem", "hbm", HBM_BPS),
     "pcie": ("hbm", "host", PCIE_BPS),
     "ici": ("hbm", "hbm", ICI_BPS),
     "dcn": ("hbm", "hbm", DCN_BPS),
+    "disk": ("host", "disk", DISK_BPS),
 }
 
 # --------------------------------------------------------------------- #

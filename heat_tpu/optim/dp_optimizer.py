@@ -323,6 +323,92 @@ class DataParallelOptimizer:
         self._step_cache[key] = fn
         return fn
 
+    # -------------------------------------------------------------- #
+    # checkpointed resume (ISSUE 13)                                  #
+    # -------------------------------------------------------------- #
+    def checkpoint_state(self) -> dict:
+        """Everything a bit-reproducible mid-training resume needs, as
+        a flat ``heat_tpu.resilience.checkpoint.save``-able dict:
+        parameters and optimizer-state leaves (replicated), the
+        per-device error-feedback carry (sharded — streamed as
+        split-blocks), the step counter the dropout key folds, and the
+        base PRNG key. The pytree STRUCTURES are not serialized — a
+        restore adopts the leaves into the structures of the receiving
+        optimizer, which must wrap the same architecture."""
+        import jax
+
+        p_leaves = jax.tree.leaves(self.model.params)
+        o_leaves = jax.tree.leaves(self.opt_state)
+        state = {f"param_{i:04d}": l for i, l in enumerate(p_leaves)}
+        state.update({f"opt_{i:04d}": l for i, l in enumerate(o_leaves)})
+        state["base_key"] = np.asarray(jax.device_get(self._base_key))
+        state["iter"] = int(self._iter)
+        state["n_params"] = len(p_leaves)
+        state["n_opt"] = len(o_leaves)
+        state["wire_quant"] = self.wire_quant or ""
+        if self._ef_carry is not None:
+            state["ef_carry"] = self._ef_carry
+        return state
+
+    def load_checkpoint_state(self, state: dict) -> None:
+        """Adopt a restored checkpoint ONTO THE CURRENT WORLD: params/
+        optimizer leaves re-place replicated over this optimizer's
+        mesh, and the error-feedback carry re-shards split-0. On a
+        RESIZED world the carry's per-device rows fold as ``row r ->
+        r % p_new`` (summed) — the total outstanding residual, which is
+        what error feedback re-injects, is preserved exactly; on the
+        same-size world the carry restores bit-identically."""
+        import jax
+
+        comm = self.model.comm
+        repl = comm.sharding(0, None)
+        n_p, n_o = int(state["n_params"]), int(state["n_opt"])
+        p_leaves = [state[f"param_{i:04d}"] for i in range(n_p)]
+        o_leaves = [state[f"opt_{i:04d}"] for i in range(n_o)]
+        p_def = jax.tree.structure(self.model.params)
+        o_def = jax.tree.structure(self.opt_state)
+        if p_def.num_leaves != n_p or o_def.num_leaves != n_o:
+            raise ValueError(
+                f"checkpoint carries {n_p} param / {n_o} optimizer leaves "
+                f"but this optimizer has {p_def.num_leaves} / "
+                f"{o_def.num_leaves} — architectures differ"
+            )
+        # ALL validation precedes mutation: a refused restore must
+        # leave the optimizer exactly as it was
+        saved_wire = state.get("wire_quant") or None
+        if saved_wire != self.wire_quant:
+            raise ValueError(
+                f"checkpoint was written with wire_quant={saved_wire!r} but "
+                f"this optimizer runs {self.wire_quant!r} — the EF carry is "
+                "only meaningful under the same codec"
+            )
+        def _cast(l, c):
+            # non-array leaves (plain counters some transforms keep)
+            # round-trip as scalars and adopt as-is
+            dt = getattr(c, "dtype", None)
+            return jnp.asarray(l, dtype=dt) if dt is not None else l
+
+        cur_p = jax.tree.leaves(self.model.params)
+        cur_o = jax.tree.leaves(self.opt_state)
+        p_leaves = [_cast(l, c) for l, c in zip(p_leaves, cur_p)]
+        o_leaves = [_cast(l, c) for l, c in zip(o_leaves, cur_o)]
+        self.model.params = jax.device_put(jax.tree.unflatten(p_def, p_leaves), repl)
+        self.opt_state = jax.device_put(jax.tree.unflatten(o_def, o_leaves), repl)
+        self._iter = int(state["iter"])
+        self._base_key = jnp.asarray(state["base_key"])
+        carry = state.get("ef_carry")
+        if carry is None or self.wire_quant is None:
+            self._ef_carry = None
+            return
+        host = np.asarray(jax.device_get(carry), dtype=np.float32)
+        p_new = comm.size
+        if host.shape[0] != p_new:
+            folded = np.zeros((p_new,) + host.shape[1:], dtype=host.dtype)
+            for r in range(host.shape[0]):
+                folded[r % p_new] += host[r]
+            host = folded
+        self._ef_carry = jax.device_put(jnp.asarray(host), comm.sharding(2, 0))
+
     def step(self, x: DNDarray, y: DNDarray) -> DNDarray:
         """One fused train step on a global batch; returns the global-mean
         loss as a 0-d replicated DNDarray (no host sync)."""

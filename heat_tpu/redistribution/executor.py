@@ -877,6 +877,16 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
     out per ``spec.src_split``) and return the dst-layout physical
     array. Trace-safe: under a trace the cached jitted programs inline
     and the eager placements lower to sharding constraints."""
+    # world-epoch fence (ISSUE 13): an in-flight collective entering on
+    # a communicator the elastic runtime stamped for a world that has
+    # since re-resolved raises the typed WorldChangedError instead of
+    # hanging on devices that are gone. Zero-cost by construction when
+    # no communicator was ever stamped (the default and the
+    # HEAT_TPU_RESILIENCE=0 escape hatch: one empty-dict truthiness
+    # check), so the pre-resilience dispatch path is untouched.
+    from ..resilience import elastic as _elastic
+
+    _elastic.check_world(comm)
     if sched is None:
         sched = _planner.plan(spec)
     else:

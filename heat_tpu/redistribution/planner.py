@@ -323,9 +323,17 @@ def budget_bytes() -> int:
     return max(1, mb) << 20
 
 
-def clear_plan_cache() -> None:
+def clear_plan_cache() -> int:
+    """Drop every cached schedule; returns the eviction count. Plans
+    are pure metadata keyed on (spec, budget, codec, topology) — a
+    world change can never serve a WRONG one — but a resized world
+    leaves the dead world's entries unreachable, and the elastic
+    runtime's eviction sweep (``heat_tpu.resilience.elastic.
+    invalidate_caches``, ISSUE 13) reclaims them here."""
     with _plan_lock:
+        n = len(_plan_cache)
         _plan_cache.clear()
+    return n
 
 
 # --------------------------------------------------------------------- #

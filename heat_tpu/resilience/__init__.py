@@ -1,0 +1,100 @@
+"""heat_tpu.resilience — elastic, fault-tolerant runtime (ISSUE 13).
+
+Heavy traffic runs on preemptible TPU fleets, where a lost slice is the
+common case, not the exception — yet until this package a preemption
+was a hang or a crash, and a resized world left every topology-keyed
+cache holding entries for devices that no longer exist. Four
+coordinated pieces close that gap:
+
+- :mod:`~heat_tpu.resilience.checkpoint` — deterministic slab-streamed
+  checkpointing: a versioned sha256-keyed envelope (gate roster +
+  topology stamped, atomic rename commit, host memory O(slab) and
+  RECORDED) capturing estimator/optimizer state mid-``fit`` — cluster
+  centers/streaming counts, ``DataParallelOptimizer`` params +
+  error-feedback carry, and the explicit RNG stream state. Restore
+  re-shards onto the CURRENT world and the resumed ``fit(ckpt=)`` /
+  ``partial_fit`` stream is bit-reproducible.
+- :mod:`~heat_tpu.resilience.elastic` — world re-resolution: a
+  pluggable :class:`WorldWatcher` (simulated on CPU meshes), the
+  world-epoch bump + cache eviction sweep (plan / program / ``ht.jit``
+  caches), the typed :class:`WorldChangedError` fence for in-flight
+  collectives, and the :func:`elastic_fit` detect→restore→resume
+  driver.
+- serving failover — ``Dispatcher.drain(reason="resize")`` fences
+  in-flight batches and resolves queued futures as
+  ``ServingOverloaded(reason="resize")`` (load balancers fail over
+  instead of backing off — the PR 9 shutdown contract extended), then
+  :func:`drain_and_rewarm` re-warms endpoint programs against the new
+  world from the AOT store.
+- :mod:`~heat_tpu.resilience.chaos` — a deterministic, seedable fault
+  harness (kill a simulated slice / poison a collective / truncate a
+  checkpoint, each at a declared step) driving the chaos CI leg: a
+  slice dies mid-``fit`` and the checkpoint-resumed run is pinned
+  bit-identical to an uninterrupted one.
+
+Gates: ``HEAT_TPU_RESILIENCE=0/1/auto`` (``0`` = exact pre-resilience
+paths, the bit-for-bit escape hatch) and ``HEAT_TPU_CKPT_DIR`` (the
+checkpoint store root — a trust boundary like the AOT store), both
+declared in ``core/gates.py``.
+"""
+
+from __future__ import annotations
+
+from .checkpoint import (
+    CheckpointConfig,
+    CheckpointCorrupt,
+    ckpt_dir,
+    latest_step,
+    list_steps,
+    load,
+    resilience_enabled,
+    resilience_mode,
+    restore_latest,
+    save,
+)
+from .elastic import (
+    CollectivePoisoned,
+    SimulatedWorldWatcher,
+    WorldChangedError,
+    WorldEvent,
+    WorldWatcher,
+    check_world,
+    drain_and_rewarm,
+    elastic_fit,
+    invalidate_caches,
+    resolve_world,
+    world_epoch,
+)
+from .chaos import ChaosMonkey
+
+from . import checkpoint
+from . import chaos
+from . import elastic
+
+__all__ = [
+    "ChaosMonkey",
+    "CheckpointConfig",
+    "CheckpointCorrupt",
+    "CollectivePoisoned",
+    "SimulatedWorldWatcher",
+    "WorldChangedError",
+    "WorldEvent",
+    "WorldWatcher",
+    "chaos",
+    "check_world",
+    "checkpoint",
+    "ckpt_dir",
+    "drain_and_rewarm",
+    "elastic",
+    "elastic_fit",
+    "invalidate_caches",
+    "latest_step",
+    "list_steps",
+    "load",
+    "resilience_enabled",
+    "resilience_mode",
+    "resolve_world",
+    "restore_latest",
+    "save",
+    "world_epoch",
+]

@@ -1,0 +1,167 @@
+"""Deterministic fault injection — the chaos harness (ISSUE 13).
+
+A resilience mechanism that was never exercised is a mechanism that
+does not work; a chaos harness that fires nondeterministically is a CI
+flake. This module injects exactly three failure classes, each at a
+DECLARED stream step, each reproducible from a seed:
+
+- **kill a simulated slice** mid-``fit``: wires a
+  :class:`~heat_tpu.resilience.elastic.SimulatedWorldWatcher` slice
+  loss at the declared step (the watcher's poll raises the world
+  change into the stream loop);
+- **poison a collective**: the staged window buffer of the declared
+  step is overwritten with NaNs before the update consumes it — the
+  observable signature of a corrupted exchange — which the stream
+  loop's finite-state validation converts into the typed
+  :class:`~heat_tpu.resilience.elastic.CollectivePoisoned`;
+- **truncate a checkpoint**: after the declared step's envelope
+  commits, its largest entry file is cut short — restore must detect
+  the mutilation (sha256/length mismatch → ``CheckpointCorrupt``) and
+  fall back to the previous committed step.
+
+The seed drives every UNDECLARED choice (which slice dies, how many
+bytes survive a truncation) through one ``random.Random(seed)`` stream,
+so two monkeys with the same seed and the same declarations produce
+byte-identical injection schedules — the chaos CI leg's determinism
+contract. ``scripts/chaos_drill.py`` is the end-to-end consumer.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+from typing import Dict, List, Optional
+
+from . import elastic as _elastic
+
+__all__ = ["ChaosMonkey"]
+
+
+class ChaosMonkey:
+    """Seeded, declarative fault injector for the streaming-fit loop.
+
+    Usage::
+
+        monkey = (ChaosMonkey(seed=7)
+                  .kill_slice(step=5)            # slice chosen by seed
+                  .poison_collective(step=9)
+                  .truncate_checkpoint(step=12))
+        watcher = monkey.watcher(topology="2x4")
+        ht.resilience.elastic_fit(model, host, ckpt=cfg,
+                                  watcher=watcher, chaos=monkey)
+
+    Every event fires AT MOST ONCE (a resumed stream does not re-kill
+    the slice it already killed — preemption is modeled as an event,
+    not a state).
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._kills: Dict[int, Optional[int]] = {}
+        self._poisons: Dict[int, bool] = {}
+        self._truncations: Dict[int, Optional[int]] = {}
+        self._next_window = 0
+        self.log: List[dict] = []
+
+    # ------------------------------------------------------------------ #
+    # declarations
+    # ------------------------------------------------------------------ #
+    def kill_slice(self, step: int, slice_index: Optional[int] = None) -> "ChaosMonkey":
+        """At stream step ``step``, preempt one slice (``slice_index``
+        or a seed-drawn one)."""
+        self._kills[int(step)] = slice_index
+        return self
+
+    def poison_collective(self, step: int) -> "ChaosMonkey":
+        """At stream step ``step``, corrupt the staged exchange buffer
+        (NaN payload) before the update consumes it."""
+        self._poisons[int(step)] = True
+        return self
+
+    def truncate_checkpoint(self, step: int, keep_bytes: Optional[int] = None) -> "ChaosMonkey":
+        """After the checkpoint covering stream step ``step`` commits,
+        truncate its largest entry to ``keep_bytes`` (or a seed-drawn
+        fraction)."""
+        self._truncations[int(step)] = keep_bytes
+        return self
+
+    # ------------------------------------------------------------------ #
+    # wiring
+    # ------------------------------------------------------------------ #
+    def watcher(self, comm=None, topology=None) -> _elastic.SimulatedWorldWatcher:
+        """A :class:`SimulatedWorldWatcher` with every declared slice
+        kill scheduled (seed resolves unspecified slice indices)."""
+        w = _elastic.SimulatedWorldWatcher(comm=comm, topology=topology)
+        topo = w._topology
+        n_slices = topo.n_slices if topo.tiered else 2
+        for step, idx in sorted(self._kills.items()):
+            if idx is None:
+                idx = self._rng.randrange(n_slices)
+                self._kills[step] = idx
+            w.kill_slice_at(step, idx)
+            self.log.append({"step": step, "kind": "kill-slice", "slice": idx})
+        return w
+
+    def poison_put(self, base_put=None):
+        """A ``device_put`` replacement for ``staging.stream_windows``:
+        the declared steps' windows are staged as NaNs. The step counter
+        is the WINDOW INDEX the stream reports via :meth:`bind_offset`
+        (a resumed stream re-binds so global window numbering holds)."""
+        import jax
+        import numpy as np
+
+        put = base_put or jax.device_put
+        monkey = self
+
+        def chaos_put(host_block):
+            step = monkey._next_window
+            monkey._next_window += 1
+            if monkey._poisons.pop(step, None):
+                monkey.log.append({"step": step, "kind": "poison-collective"})
+                poisoned = np.full_like(np.asarray(host_block), np.nan)
+                return put(poisoned)
+            return put(host_block)
+
+        return chaos_put
+
+    def bind_offset(self, window: int) -> None:
+        """Tell the poison counter which GLOBAL window the stream will
+        stage next (stream restarts re-bind here)."""
+        self._next_window = int(window)
+
+    def after_checkpoint(self, path: str, step: int) -> None:
+        """Post-commit hook the checkpointing stream calls: apply any
+        declared truncation to the just-committed envelope."""
+        keep = self._truncations.pop(int(step), "absent")
+        if keep == "absent":
+            return
+        victim, size = None, -1
+        for name in os.listdir(path):
+            if name.endswith(".bin"):
+                s = os.path.getsize(os.path.join(path, name))
+                if s > size:
+                    victim, size = name, s
+        if victim is None:
+            return
+        if keep is None:
+            keep = self._rng.randrange(max(1, size // 2))
+        with open(os.path.join(path, victim), "r+b") as f:
+            f.truncate(int(keep))
+        self.log.append(
+            {"step": int(step), "kind": "truncate-ckpt", "entry": victim,
+             "kept_bytes": int(keep), "was_bytes": size}
+        )
+
+    def schedule(self) -> List[dict]:
+        """The declared schedule (before firing) — what two same-seed
+        monkeys must agree on byte-for-byte."""
+        out = []
+        for step, idx in sorted(self._kills.items()):
+            out.append({"step": step, "kind": "kill-slice", "slice": idx})
+        for step in sorted(self._poisons):
+            out.append({"step": step, "kind": "poison-collective"})
+        for step, keep in sorted(self._truncations.items()):
+            out.append({"step": step, "kind": "truncate-ckpt", "keep_bytes": keep})
+        return out

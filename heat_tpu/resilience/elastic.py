@@ -1,0 +1,367 @@
+"""World re-resolution — elastic response to slice loss and resize.
+
+The plan/program/AOT cache keys have carried topology since PR 8, so a
+RESOLVED world change can never serve a wrong-world program — but
+nothing before this module ever resolved one: a lost slice was a hang,
+and caches keyed for the dead world lingered forever. This module adds
+the runtime half (ISSUE 13):
+
+- a **world epoch**: every re-resolution bumps one monotonic counter;
+  communicators the elastic runtime has stamped are fenced against it,
+  and an in-flight collective entering the redistribution executor
+  under a stale-epoch communicator raises the typed
+  :class:`WorldChangedError` instead of hanging on devices that are
+  gone (zero-cost when no communicator was ever stamped — the default
+  and the ``HEAT_TPU_RESILIENCE=0`` escape hatch);
+- an **eviction sweep** (:func:`invalidate_caches`): the executor's
+  registered mesh-keyed program caches, the planner's schedule cache,
+  and every live ``ht.jit`` wrapper cache are dropped in one call — the
+  epoch bump makes stale entries unreachable, the sweep frees them;
+- a pluggable :class:`WorldWatcher` with a CPU-mesh
+  :class:`SimulatedWorldWatcher` (the chaos harness's instrument): a
+  declared slice loss shrinks the simulated world at a declared stream
+  step, deterministically;
+- :func:`resolve_world`: build + install the communicator over the
+  surviving devices (``Topology`` re-resolves on the new size through
+  the PR 8 machinery) and stamp it with the current epoch;
+- :func:`elastic_fit`: the detect → checkpoint-restore → re-resolve →
+  resume driver for streaming fits, and
+  :func:`drain_and_rewarm` for the serving side (dispatcher drain with
+  ``ServingOverloaded(reason="resize")``, endpoint re-warm from the AOT
+  store against the new world).
+"""
+
+from __future__ import annotations
+
+import time
+
+from typing import Callable, Dict, List, Optional
+
+from . import checkpoint as _ckpt
+from ..core import communication as _comm_mod
+from ..observability import telemetry as _telemetry
+
+__all__ = [
+    "CollectivePoisoned",
+    "SimulatedWorldWatcher",
+    "WorldChangedError",
+    "WorldEvent",
+    "WorldWatcher",
+    "check_world",
+    "drain_and_rewarm",
+    "elastic_fit",
+    "invalidate_caches",
+    "resolve_world",
+    "stamp",
+    "world_epoch",
+]
+
+
+class WorldChangedError(RuntimeError):
+    """Typed world-change signal: the device world this work was bound
+    to is gone (slice loss, resize). Carries what a supervisor needs to
+    act — the reason, the epoch the work was stamped with, and the old/
+    new world sizes. In-flight collectives surface it instead of
+    hanging; the elastic driver catches it, re-resolves, and resumes
+    from the last committed checkpoint."""
+
+    def __init__(self, reason: str, old_size: Optional[int] = None,
+                 new_size: Optional[int] = None, epoch: Optional[int] = None):
+        self.reason = reason
+        self.old_size = old_size
+        self.new_size = new_size
+        self.epoch = epoch
+        msg = f"world changed ({reason})"
+        if old_size is not None or new_size is not None:
+            msg += f": {old_size} -> {new_size} devices"
+        if epoch is not None:
+            msg += f" (epoch {epoch})"
+        super().__init__(msg)
+
+
+class CollectivePoisoned(RuntimeError):
+    """A window update produced non-finite state — the signature of a
+    poisoned collective / corrupted exchange. The elastic driver treats
+    it like a failure: restore from the last committed checkpoint and
+    re-run the poisoned window."""
+
+
+class WorldEvent:
+    """One observed world change: ``kind`` (``"slice-lost"`` /
+    ``"resize"``), the surviving device list, and free-form detail."""
+
+    __slots__ = ("kind", "devices", "detail")
+
+    def __init__(self, kind: str, devices: list, detail: Optional[dict] = None):
+        self.kind = kind
+        self.devices = list(devices)
+        self.detail = dict(detail or {})
+
+    def __repr__(self) -> str:
+        return f"WorldEvent({self.kind!r}, {len(self.devices)} devices, {self.detail})"
+
+
+class WorldWatcher:
+    """The pluggable failure detector. ``poll(step)`` returns a
+    :class:`WorldEvent` when the world changed since the last poll (or
+    ``None``); ``devices()`` is the current surviving world. The base
+    class watches nothing — real deployments plug the fleet's health
+    endpoint in; tests and the chaos harness use
+    :class:`SimulatedWorldWatcher`."""
+
+    def poll(self, step: Optional[int] = None) -> Optional[WorldEvent]:
+        return None
+
+    def devices(self) -> list:
+        return _comm_mod.get_comm().devices
+
+
+class SimulatedWorldWatcher(WorldWatcher):
+    """Deterministic CPU-mesh watcher: slice losses / resizes are
+    DECLARED at stream steps and fire exactly there — the instrument
+    the chaos harness and the CI leg drive. Slices follow the PR 8
+    slice-major layout: slice ``s`` of an ``SxC`` topology owns the
+    contiguous device positions ``[s*C, (s+1)*C)``."""
+
+    def __init__(self, comm=None, topology=None):
+        comm = comm or _comm_mod.get_comm()
+        self._all = list(comm.devices)
+        self._devices = list(self._all)
+        self._topology = topology if topology is not None else comm.topology
+        if isinstance(self._topology, str):
+            self._topology = _comm_mod.topology_for(len(self._all), self._topology)
+        self._pending: Dict[int, tuple] = {}
+        self.events: List[WorldEvent] = []
+
+    def kill_slice_at(self, step: int, slice_index: int = 0) -> "SimulatedWorldWatcher":
+        """Declare: at stream step ``step`` the ``slice_index``-th slice
+        of the watcher's topology is preempted."""
+        self._pending[int(step)] = ("slice-lost", int(slice_index))
+        return self
+
+    def resize_at(self, step: int, n_devices: int) -> "SimulatedWorldWatcher":
+        """Declare: at stream step ``step`` the world becomes its first
+        ``n_devices`` devices (a planned shrink/grow-back)."""
+        self._pending[int(step)] = ("resize", int(n_devices))
+        return self
+
+    def poll(self, step: Optional[int] = None) -> Optional[WorldEvent]:
+        evt = self._pending.pop(int(step or 0), None)
+        if evt is None:
+            return None
+        kind, arg = evt
+        old_size = len(self._devices)
+        if kind == "slice-lost":
+            topo = self._topology
+            c = topo.chips_per_slice if topo.tiered else max(1, len(self._devices) // 2)
+            lost = set(range(arg * c, (arg + 1) * c))
+            survivors = [
+                d for i, d in enumerate(self._all) if i not in lost and d in self._devices
+            ]
+            detail = {"slice_index": arg, "chips_lost": len(lost), "old_size": old_size}
+        else:
+            survivors = self._all[:arg]
+            detail = {"resize_to": arg, "old_size": old_size}
+        if not survivors:
+            raise ValueError("SimulatedWorldWatcher: a declared event left zero devices")
+        self._devices = survivors
+        event = WorldEvent(kind, survivors, detail)
+        self.events.append(event)
+        return event
+
+    def devices(self) -> list:
+        return list(self._devices)
+
+
+# --------------------------------------------------------------------- #
+# world epoch + the collective fence
+# --------------------------------------------------------------------- #
+_EPOCH = 0
+#: flipped once the elastic runtime ever stamps a communicator — the
+#: default path's zero-cost gate (one module-global truthiness check)
+_ANY_STAMPED = False
+
+
+def world_epoch() -> int:
+    """The monotonic world epoch (bumped by every
+    :func:`invalidate_caches`)."""
+    return _EPOCH
+
+
+def stamp(comm) -> None:
+    """Bind ``comm`` to the current epoch: once a later re-resolution
+    bumps the epoch, work entering the redistribution executor under
+    this communicator raises :class:`WorldChangedError`. The stamp
+    lives ON the communicator (a dedicated slot), never in an id-keyed
+    side table — a recycled object id can therefore never inherit a
+    dead communicator's stamp."""
+    global _ANY_STAMPED
+    comm._ht_epoch = _EPOCH
+    _ANY_STAMPED = True
+
+
+def _clear_stamps() -> None:
+    """Disarm the fence (test hook / process-level reset)."""
+    global _ANY_STAMPED
+    _ANY_STAMPED = False
+
+
+def check_world(comm) -> None:
+    """The in-flight fence the executor calls: zero-cost (one module
+    flag check) until the elastic runtime stamps a communicator, a
+    no-op under ``HEAT_TPU_RESILIENCE=0``."""
+    if not _ANY_STAMPED:
+        return
+    e = getattr(comm, "_ht_epoch", None)
+    if e is None or e == _EPOCH:
+        return
+    if not _ckpt.resilience_enabled(explicit=True):
+        return
+    raise WorldChangedError(
+        "stale-epoch communicator", old_size=getattr(comm, "size", None),
+        new_size=len(_comm_mod.get_comm().devices), epoch=e,
+    )
+
+
+def invalidate_caches(reason: str = "resize") -> Dict[str, int]:
+    """The epoch bump + eviction sweep: drop every cache whose entries
+    were built for the dead world — the executor's registered mesh-keyed
+    program caches, the planner's schedule cache, and every live
+    ``ht.jit`` wrapper cache. The keys already carry topology/comm
+    identity (PR 8), so staleness was never a correctness risk; the
+    sweep reclaims the memory and the bump arms the in-flight fence.
+    Returns eviction counts per cache family."""
+    global _EPOCH
+    _EPOCH += 1
+    import importlib
+
+    from ..redistribution import executor as _executor, planner as _planner
+
+    # heat_tpu.core.jit the MODULE is shadowed by the jit FUNCTION in
+    # the core package namespace — importlib resolves the module
+    jit_mod = importlib.import_module("heat_tpu.core.jit")
+    plans = _planner.clear_plan_cache()
+    programs = 0
+    for fn in _comm_mod._MESH_KEYED_CACHES:
+        programs += fn.cache_info().currsize
+    _comm_mod._clear_mesh_caches()
+    _executor.clear_program_cache()  # idempotent with the sweep above
+    wrappers = jit_mod.clear_wrapper_caches()
+    # order-independence with resolve_world: a communicator stamped as
+    # THE CURRENT WORLD moves forward with the bump — only dead worlds'
+    # comms stay behind and trip the fence (resolve-then-invalidate and
+    # invalidate-then-resolve both leave the installed world live)
+    cur = _comm_mod.get_comm()
+    if getattr(cur, "_ht_epoch", None) is not None:
+        cur._ht_epoch = _EPOCH
+    counts = {"plans": plans, "programs": programs, "jit_entries": wrappers}
+    if _telemetry._ENABLED:
+        from ..observability import events as _obs_events
+
+        _telemetry.inc("resilience.world.invalidate")
+        _obs_events.emit(
+            "resilience.world.invalidate", reason=reason, epoch=_EPOCH, **counts
+        )
+    return counts
+
+
+def resolve_world(devices: Optional[list] = None) -> "_comm_mod.MeshCommunication":
+    """Build the communicator over the SURVIVING world, install it as
+    the global default, and stamp it with the current epoch. The
+    ``Topology`` re-resolves through the PR 8 machinery on the new size
+    (``HEAT_TPU_TOPOLOGY`` semantics unchanged: a forced factorization
+    that no longer divides the shrunk world resolves flat)."""
+    if devices is None:
+        devices = _comm_mod.MPI_WORLD.devices
+    comm = _comm_mod.MeshCommunication(list(devices))
+    _comm_mod.use_comm(comm)
+    stamp(comm)
+    if _telemetry._ENABLED:
+        _telemetry.inc("resilience.world.resolve")
+    return comm
+
+
+# --------------------------------------------------------------------- #
+# the elastic training driver
+# --------------------------------------------------------------------- #
+def _finite_state(model) -> bool:
+    """Host check that the model's streaming state is finite — the
+    poisoned-collective detector (declared host boundary
+    ``resilience-state-validate``: the centers are a (k, d) scalar-class
+    array, and the read IS the detection)."""
+    import jax
+    import numpy as np
+
+    centers = model._cluster_centers
+    if centers is None:
+        return True
+    host = np.asarray(jax.device_get(centers.larray))
+    return bool(np.isfinite(host).all())
+
+
+def elastic_fit(model, host, *, ckpt: "_ckpt.CheckpointConfig",
+                watcher: Optional[WorldWatcher] = None,
+                chaos=None, max_failures: int = 4):
+    """Fault-tolerant streaming fit: run ``model.fit(host, ckpt=ckpt)``
+    under a :class:`WorldWatcher` (and optionally a chaos harness);
+    on :class:`WorldChangedError` / :class:`CollectivePoisoned`,
+    re-resolve the world onto the survivors, bump the epoch + sweep the
+    caches, and resume from the newest committed checkpoint — the
+    resumed run replays the remaining windows and reproduces the
+    uninterrupted run's bits (the chaos CI leg's pin).
+
+    With ``HEAT_TPU_RESILIENCE=0`` this is exactly ``model.fit(host)``:
+    no checkpoints, no fences, no watcher polls."""
+    if not _ckpt.resilience_enabled(explicit=True):
+        return model.fit(host)
+    failures = 0
+    while True:
+        try:
+            return model.fit(host, ckpt=ckpt, _watcher=watcher, _chaos=chaos)
+        except (WorldChangedError, CollectivePoisoned) as e:
+            failures += 1
+            if _telemetry._ENABLED:
+                _telemetry.inc("resilience.fit.failover")
+            if failures > max_failures:
+                raise
+            if isinstance(e, WorldChangedError) and watcher is not None:
+                resolve_world(watcher.devices())
+            invalidate_caches(reason=getattr(e, "reason", "poisoned"))
+            # the resumed attempt restores from the newest committed
+            # checkpoint inside fit(ckpt=) — nothing else to carry over
+
+
+# --------------------------------------------------------------------- #
+# serving failover
+# --------------------------------------------------------------------- #
+def drain_and_rewarm(dispatcher, rebuild_endpoint: Callable[[], object],
+                     reason: str = "resize", timeout: float = 30.0):
+    """The serving half of a world change: fence the dispatcher's
+    in-flight batches and shed its queue as
+    ``ServingOverloaded(reason="resize")`` (load balancers FAIL OVER on
+    that reason — the PR 9 shutdown contract extended), then rebuild
+    the endpoint against the CURRENT world — ``rebuild_endpoint()``
+    resolves its bucket programs through ``serving.aot_cache.
+    ensure_program``, so a store warmed for this world serves them
+    without compiling — and resume. Returns the new endpoint.
+
+    A drain that cannot confirm within ``timeout`` raises: swapping the
+    endpoint under a live (un-parked) worker would hand batches
+    collected against the old endpoint to the new one's programs, and
+    clearing the pause early would serve requests the resize contract
+    promised to shed — a wedged in-flight batch means this REPLICA is
+    lost, and the caller must escalate, not pretend the failover
+    happened."""
+    if not dispatcher.drain(reason=reason, timeout=timeout):
+        raise TimeoutError(
+            f"dispatcher drain ({reason}) did not confirm within "
+            f"{timeout}s — the in-flight batch is wedged; escalate "
+            "(replace the replica) instead of rewarming under a live worker"
+        )
+    t0 = time.perf_counter()
+    endpoint = rebuild_endpoint()
+    dispatcher.resume(endpoint=endpoint)
+    if _telemetry._ENABLED:
+        _telemetry.observe("resilience.serving.rewarm", time.perf_counter() - t0)
+        _telemetry.inc("resilience.serving.failover")
+    return endpoint

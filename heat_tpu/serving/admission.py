@@ -37,9 +37,13 @@ class ServingOverloaded(RuntimeError):
         at depth limit), ``"deadline"`` (shed at dequeue: the request's
         deadline passed while it waited), ``"shutdown"`` (the
         dispatcher stopped before serving the queued request — retry
-        against a live replica, do NOT back off as if overloaded), or
-        ``"hbm-estimate"`` (rejected at submit: the endpoint program's
-        STATIC peak-HBM estimate — ``ht.analysis.memcheck``'s
+        against a live replica, do NOT back off as if overloaded),
+        ``"resize"`` (ISSUE 13: the dispatcher is draining for a world
+        change — ``Dispatcher.drain``; like shutdown, FAIL OVER to
+        another replica immediately instead of backing off: this
+        replica re-warms against the re-resolved world and comes back),
+        or ``"hbm-estimate"`` (rejected at submit: the endpoint
+        program's STATIC peak-HBM estimate — ``ht.analysis.memcheck``'s
         ``static_peak_bytes`` — exceeds the per-device budget, so the
         request would OOM, not queue; route it to a bigger replica).
     queue_depth : observed queue depth at decision time.

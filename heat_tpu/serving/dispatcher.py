@@ -159,6 +159,14 @@ class Dispatcher:
         # read by the worker only after it observes _stop — the Event is
         # the fence
         self._drain_on_stop = True  # racecheck: guarded-by(_stop event ordering)
+        # elastic failover (ISSUE 13): drain() pauses collection, the
+        # worker fences the in-flight batch, sheds the queue typed, and
+        # parks until resume(). The reason is written by drain() BEFORE
+        # _pause.set() and read by the worker only after it observes
+        # _pause — same fence discipline as _drain_on_stop.
+        self._pause = threading.Event()
+        self._drained = threading.Event()
+        self._pause_reason = "resize"  # racecheck: guarded-by(_pause event ordering)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lat: collections.deque = collections.deque(maxlen=_LAT_CAP)
@@ -197,9 +205,9 @@ class Dispatcher:
         # post-join sweep: a submit() that raced the worker's final
         # drain pass may have enqueued after the last get — its future
         # would otherwise never resolve
-        self._fail_queued("post-stop sweep")
+        self._fail_queued("shutdown")
 
-    def _fail_queued(self, _why: str) -> None:
+    def _fail_queued(self, reason: str = "shutdown") -> int:
         leftovers = list(self._carry)
         self._carry.clear()
         while True:
@@ -210,8 +218,44 @@ class Dispatcher:
         for r in leftovers:
             if not r.future.done():
                 r.future.set_exception(
-                    ServingOverloaded("shutdown", queue_depth=len(leftovers))
+                    ServingOverloaded(reason, queue_depth=len(leftovers))
                 )
+        return len(leftovers)
+
+    # ------------------------------------------------------------------ #
+    # elastic failover (ISSUE 13)                                        #
+    # ------------------------------------------------------------------ #
+    def drain(self, reason: str = "resize", timeout: float = 30.0) -> bool:
+        """Fence and shed for a world change: the worker completes (and
+        resolves) the in-flight batch, every QUEUED request's future
+        fails typed — ``ServingOverloaded(reason="resize")`` by default,
+        which load balancers treat as "fail over to another replica",
+        extending the PR 9 shutdown contract — and the worker parks.
+        New ``submit`` calls are rejected with the same reason until
+        :meth:`resume`. Returns True once the worker confirms the drain
+        (False on timeout; the pause stays armed either way)."""
+        self._pause_reason = reason  # racecheck: guarded-by(_pause event ordering)
+        self._drained.clear()
+        self._pause.set()
+        if _telemetry._ENABLED:
+            _telemetry.inc("serving.drain.count")
+        if not self.running:
+            # no worker to confirm: sweep here (nothing can be in flight)
+            self._fail_queued(reason)
+            self._drained.set()
+            return True
+        return self._drained.wait(timeout)
+
+    def resume(self, endpoint: Optional[Endpoint] = None) -> None:
+        """Unpark after a :meth:`drain` — optionally swapping in an
+        endpoint rebuilt against the re-resolved world (its bucket
+        programs come through ``aot_cache.ensure_program``, so a store
+        warmed for that world serves them without compiling)."""
+        if endpoint is not None:
+            # written only while the worker is parked behind _pause
+            self.endpoint = endpoint  # racecheck: guarded-by(_pause event ordering)
+        self._drained.clear()
+        self._pause.clear()
 
     def __enter__(self) -> "Dispatcher":
         return self.start()
@@ -232,6 +276,14 @@ class Dispatcher:
         a ``Future`` resolving to the n-row device-array result."""
         if not self.running:
             raise RuntimeError("dispatcher is not running — call start() or use a with block")
+        if self._pause.is_set():
+            # draining for a world change: fail fast with the drain
+            # reason so the load balancer fails over immediately
+            with self._counts_lock:
+                self._counts["rejected"] += 1
+            if _telemetry._ENABLED:
+                _telemetry.inc("serving.admission.rejected")
+            raise ServingOverloaded(self._pause_reason, queue_depth=self._q.qsize())
         x = np.asarray(x, dtype=self.endpoint.dtype)
         if x.shape == self.endpoint.feature_shape:
             x = x[None]
@@ -274,7 +326,7 @@ class Dispatcher:
             # resolves typed instead of hanging. If the final drain
             # already served it, the future holds a result and passes
             # through untouched.
-            self._fail_queued("submit raced stop")
+            self._fail_queued("shutdown")  # submit raced stop()
             exc = req.future.exception() if req.future.done() else None
             if exc is not None:
                 raise exc
@@ -411,6 +463,23 @@ class Dispatcher:
     def _worker(self) -> None:
         inflight = None
         while True:
+            if self._pause.is_set() and not self._stop.is_set():
+                # elastic drain: fence the in-flight batch (its futures
+                # RESOLVE — work already on the accelerator completes),
+                # shed the backlog typed with the drain reason, confirm,
+                # and park until resume() or stop()
+                if inflight is not None:
+                    self._resolve(inflight)
+                    inflight = None
+                n = self._fail_queued(self._pause_reason)
+                if n:
+                    with self._counts_lock:
+                        self._counts["shed"] += n
+                    if _telemetry._ENABLED:
+                        _telemetry.inc("serving.drain.shed", n)
+                self._drained.set()
+                self._stop.wait(self._poll_s)  # parked; re-checks both events
+                continue
             # stop(drain=False): collect nothing more — still-queued
             # requests fail typed below; the in-flight batch completes
             draining = not (
@@ -429,7 +498,7 @@ class Dispatcher:
                     if self._carry or not self._q.empty():
                         continue  # keep serving until the backlog is gone
                 else:
-                    self._fail_queued("stop without drain")
+                    self._fail_queued("shutdown")
                 break
 
 

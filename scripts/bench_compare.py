@@ -91,6 +91,11 @@ HIGHER_IS_BETTER = {
     "stage_bw_frac",
     "stage_model_gbps",
     "rows_per_s",
+    # resilience acceptance fields (ISSUE 13) on the ckpt_write_2gb
+    # row: durable slab-streamed commit throughput and its fraction of
+    # the lattice's host->disk durable-commit bound (floor 0.5 pinned)
+    "write_gbps",
+    "bound_frac",
 }
 
 # rows that changed name across rounds: a baseline row under the old
@@ -123,6 +128,11 @@ LOWER_IS_BETTER = {
     # pre-TPU (the xla_* cross-check fields are informational: the
     # compiler's buffer assignment moves with XLA versions)
     "static_peak_bytes",
+    # ISSUE 13: the recovery_resume row's detect→drain→rekey→restore
+    # wall-clock (and the resumed replay) — growth means the failover
+    # control plane slowed down
+    "recovery_s",
+    "resume_s",
 }
 
 

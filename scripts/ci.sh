@@ -145,13 +145,41 @@ HEAT_TPU_OOC=1 python -m pytest tests/test_staging.py tests/test_linalg.py tests
 
 HEAT_TPU_OOC=0 python -m pytest tests/test_staging.py tests/test_linalg.py -q "$@"
 
+# resilience legs (ISSUE 13): (24) the chaos drill at the even AND odd
+# meshes — a seeded slice kill mid-fit at the simulated 2x4 topology:
+# detection is a typed WorldChangedError (never a hang), the live
+# dispatcher's queued requests shed as
+# ServingOverloaded(reason="resize") while its in-flight batch
+# COMPLETES, the world re-resolves onto the survivors with the epoch
+# bump + cache sweep, and the checkpoint-resumed fit is BIT-IDENTICAL
+# to an uninterrupted same-seed run (a chaos-truncated newest envelope
+# falls back to its committed predecessor); (25) the resilience +
+# serving suites with the runtime FORCED on; (26) the
+# HEAT_TPU_RESILIENCE=0 escape hatch: golden plan dumps byte-identical
+# with the runtime off, and the suite's escape-hatch pins pass
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+  HEAT_TPU_RESILIENCE=1 python scripts/chaos_drill.py
+XLA_FLAGS="--xla_force_host_platform_device_count=5" JAX_PLATFORMS=cpu \
+  HEAT_TPU_RESILIENCE=1 python scripts/chaos_drill.py
+
+HEAT_TPU_RESILIENCE=1 python -m pytest tests/test_resilience.py tests/test_serving.py -q "$@"
+
+res_a="$(mktemp)"; res_b="$(mktemp)"
+python scripts/redist_plans.py > "$res_a"
+HEAT_TPU_RESILIENCE=0 python scripts/redist_plans.py > "$res_b"
+diff "$res_a" "$res_b"
+HEAT_TPU_RESILIENCE=0 python -m pytest tests/test_resilience.py -q "$@"
+echo "HEAT_TPU_RESILIENCE=0: golden dumps byte-identical + escape-hatch pins clean"
+rm -f "$res_a" "$res_b"
+
 python scripts/lint.py heat_tpu/ --pass srclint
 
 # pass-4 leg (ISSUE 12): gatecheck + racecheck over the tree at error
 # severity — gate/cache-key staleness (SL402), raw HEAT_TPU_* reads
 # bypassing the registry (SL403), lock-discipline races in the threaded
-# modules (SL404), and the depth-2 issue/consume protocol (SL405) —
-# plus the SARIF emission CI annotations consume
+# modules (SL404), the depth-2 issue/consume protocol (SL405), and the
+# swallowed-worker-exception failover hazard (SL406, ISSUE 13) — plus
+# the SARIF emission CI annotations consume
 python scripts/lint.py heat_tpu/ --pass effectcheck
 python scripts/lint.py heat_tpu/ --pass effectcheck --format sarif > /dev/null
 echo "effectcheck: SL4xx clean + SARIF emitted"

@@ -346,6 +346,110 @@ def good_laps(indices, issue, consume, state):
     return consume(state, prev, idx[-1])
 '''
 
+#: SL406 (ISSUE 13): the silent-swallow worker — a threaded request
+#: loop whose `except Exception` neither re-raises, resolves a future,
+#: nor forwards the caught object: the client's future never resolves
+#: and the failure becomes a hang. The clean twins show each accepted
+#: surfacing shape (typed future failure; forwarding the object into a
+#: queue; delegating to an intra-class helper that fails futures).
+SWALLOWED_WORKER_EXC_SRC = '''
+import threading
+
+
+class SwallowingWorker:
+    def __init__(self):
+        self._q = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            req = self._q.pop()
+            try:
+                req.run()
+            except Exception:
+                continue                      # swallowed: future never resolves
+
+
+class ResolvingWorker:
+    def __init__(self):
+        self._q = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            req = self._q.pop()
+            try:
+                req.future.set_result(req.run())
+            except Exception as e:
+                req.future.set_exception(e)   # surfaced typed
+
+
+class ForwardingWorker:
+    def __init__(self):
+        self._q = []
+        self._out = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        try:
+            for req in self._q:
+                self._out.append(req.run())
+        except Exception as exc:
+            self._out.append(("error", exc))  # forwarded to the consumer
+
+
+class DelegatingWorker:
+    def __init__(self):
+        self._q = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _fail_all(self):
+        for req in self._q:
+            req.future.set_exception(RuntimeError("failed over"))
+
+    def _worker(self):
+        try:
+            for req in self._q:
+                req.run()
+        except Exception:
+            self._fail_all()                  # intra-class resolver helper
+
+
+class LoggingSwallowWorker:
+    def __init__(self, logger):
+        self._q = []
+        self._log = logger
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            req = self._q.pop()
+            try:
+                req.run()
+            except Exception as e:
+                self._log.warning("worker died: %s", e)  # log-and-continue: STILL a swallow
+'''
+
 
 def serving_sync_handler(x):
     """SL106 (ISSUE 9): a serving request handler that reads device

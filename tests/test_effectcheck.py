@@ -143,11 +143,16 @@ class TestGateRegistry(TestCase):
 
     def test_scope_and_roster_derivations(self):
         affecting = {s.name for s in gates.affecting_programs()}
-        # the serving/telemetry switches change no program bytes
+        # the serving/telemetry switches change no program bytes, and
+        # neither does the checkpoint store path (ISSUE 13); the
+        # resilience runtime switch IS roster material (its
+        # registration version-bumps pre-resilience AOT envelopes)
         self.assertNotIn("HEAT_TPU_SERVING_AOT", affecting)
         self.assertNotIn("HEAT_TPU_SERVING_CACHE", affecting)
         self.assertNotIn("HEAT_TPU_TELEMETRY", affecting)
-        self.assertEqual(len(affecting), len(gates.GATES) - 3)
+        self.assertNotIn("HEAT_TPU_CKPT_DIR", affecting)
+        self.assertIn("HEAT_TPU_RESILIENCE", affecting)
+        self.assertEqual(len(affecting), len(gates.GATES) - 4)
         self.assertEqual(
             gates.program_gate_roster(), ",".join(sorted(affecting))
         )
@@ -414,7 +419,7 @@ class TestGoldenBadFixtures(TestCase):
         self.assertTrue(any("never consumed" in m for m in by_line.values()))
 
     def test_rules_catalogued(self):
-        for rule in ("SL401", "SL402", "SL403", "SL404", "SL405"):
+        for rule in ("SL401", "SL402", "SL403", "SL404", "SL405", "SL406"):
             self.assertIn(rule, findings.RULES)
 
 
