@@ -4,7 +4,7 @@ The north-star contract — compiled programs launch exactly the
 collectives the algorithm needs, every intermediate stays distributed,
 nothing round-trips through the host — is a *static* property of the
 traced program and the source tree. This package checks it before any
-TPU minute is spent, in four passes:
+TPU minute is spent, in five passes:
 
 - **Pass 1, IR lint** — :func:`ht.analysis.check(fn, *args) <check>`
   walks the jaxpr and compiled StableHLO of any heat_tpu program
@@ -43,6 +43,27 @@ TPU minute is spent, in four passes:
   pipeline protocol (static loop shape + the plan-annotation sweep
   :func:`check_plan_protocol`).
 
+- **Pass 5, commcheck** — :mod:`~heat_tpu.analysis.commcheck` (CLI:
+  ``python scripts/lint.py heat_tpu/ --pass commcheck``; ``--pass
+  all`` runs passes 2+4+5 in one process) proves SPMD collective
+  CONGRUENCE — the MPI-heritage failure mode that hangs a TPU mesh
+  instead of erroring: SL501 divergent-collective (a collective under
+  a ``cond``/``while`` predicate not provably replicated — a
+  replication lattice over the jaxpr decides), SL502
+  incomplete-permute (``source_target_pairs`` not a permutation of the
+  axis group, ``replica_groups`` not a partition of the mesh — the
+  shared ``_groups.py`` parser, one verdict with SL107), SL503
+  collective-order divergence (cycle in the per-axis-group channel
+  graph / unordered independent subgroup collectives), SL504
+  unfenced dispatch entry (an executor/dispatcher path issuing
+  collectives without the PR 13 epoch fence). The dynamic half —
+  :func:`check_progress` and ``verify_plan``'s ``progress`` invariant
+  — symbolically replays every Schedule-IR plan per device: rings
+  close in exactly p-1 hops, hierarchical ici/dcn lap pairs partition
+  the mesh, depth-2 lap tags never consume an unissued lap. The
+  IR rules fold into :func:`check`; the MPMD stage-graph work
+  (ROADMAP) consumes this verifier per pipeline stage.
+
 Legitimate host boundaries are declared, by name and category, in
 :mod:`~heat_tpu.analysis.boundaries` — the whitelist is code, reviewed
 like code, and tier-1 pins its exact ``core/`` population. Rule
@@ -57,11 +78,12 @@ from . import planverify
 from . import srclint
 
 from .boundaries import HOST_BOUNDARIES, is_declared_sync
+from .commcheck import commcheck
 from .effectcheck import check_donation, check_plan_protocol
 from .findings import RULES, AnalysisReport, Finding
 from .ircheck import check
 from .memcheck import hbm_budget_bytes, memcheck
-from .planverify import PlanVerificationError, verify_plan
+from .planverify import PlanVerificationError, check_progress, verify_plan
 from .srclint import lint_paths, lint_source
 
 __all__ = [
@@ -73,6 +95,8 @@ __all__ = [
     "check",
     "check_donation",
     "check_plan_protocol",
+    "check_progress",
+    "commcheck",
     "hbm_budget_bytes",
     "is_declared_sync",
     "lint_paths",

@@ -1,0 +1,153 @@
+"""ONE parser for HLO replica groups / ppermute source-target pairs.
+
+Two passes reason about the group structure of compiled collectives:
+SL107 (ircheck's cross-tier rule, PR 8) classifies which tier a
+collective's groups ride, and SL502/SL503 (commcheck, pass 5) prove the
+groups are *congruent* — a partition of the mesh, a permutation of the
+axis group. Until ISSUE 14 the parser lived inside ircheck; this module
+is the shared home, so a "cross-tier" and an "incongruent" verdict can
+never disagree about what the same HLO line says. All three textual
+forms are covered:
+
+- ``replica_groups={{0,1},{2,3}}`` — explicit groups;
+- ``replica_groups=[2,4]<=[8]`` — the iota form (rows x cols reshape of
+  ``[0, total)``, row-major: group ``r`` is ``[r*cols, (r+1)*cols)``);
+- ``source_target_pairs={{0,1},{1,2}}`` — collective-permute pairs.
+
+Parsers return ``None`` — never guess — when a line carries none of the
+forms; callers treat ``None`` as "no verdict" (conservative).
+"""
+
+from __future__ import annotations
+
+import re
+
+from typing import List, Optional, Tuple
+
+__all__ = [
+    "parse_groups",
+    "parse_replica_groups",
+    "parse_source_target_pairs",
+    "partition_defect",
+    "permutation_defect",
+]
+
+_REPLICA_GROUPS = re.compile(r"replica_groups=\{((?:\{[0-9, ]*\},?)+)\}")
+_REPLICA_IOTA = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+)\]")
+_SOURCE_TARGETS = re.compile(r"source_target_pairs=\{((?:\{[0-9, ]*\},?)+)\}")
+_GROUP = re.compile(r"\{([0-9, ]*)\}")
+
+
+def _int_groups(blob: str) -> List[List[int]]:
+    return [
+        [int(v) for v in g.split(",") if v.strip()] for g in _GROUP.findall(blob)
+    ]
+
+
+def parse_replica_groups(hlo_line: str) -> Optional[List[List[int]]]:
+    """The replica groups of one HLO collective line, as lists of device
+    ids — explicit or iota form; ``None`` when the line carries neither
+    (including ``replica_groups={}``, the all-devices default)."""
+    m = _REPLICA_GROUPS.search(hlo_line)
+    if m:
+        return _int_groups(m.group(1))
+    m = _REPLICA_IOTA.search(hlo_line)
+    if m:
+        rows, cols, total = int(m.group(1)), int(m.group(2)), int(m.group(3))
+        if rows * cols == total:
+            return [list(range(r * cols, (r + 1) * cols)) for r in range(rows)]
+    return None
+
+
+def parse_source_target_pairs(hlo_line: str) -> Optional[List[Tuple[int, int]]]:
+    """The ``source_target_pairs`` of a collective-permute line as
+    ``(source, target)`` tuples, or ``None``. Degenerate entries (a pair
+    with fewer than two ids) are kept as-is by returning ``None`` for
+    the whole line — a malformed dump is "no verdict", not a guess."""
+    m = _SOURCE_TARGETS.search(hlo_line)
+    if not m:
+        return None
+    pairs = []
+    for g in _int_groups(m.group(1)):
+        if len(g) != 2:
+            return None
+        pairs.append((g[0], g[1]))
+    return pairs
+
+
+def parse_groups(hlo_line: str) -> Optional[list]:
+    """SL107's historical merged view: replica groups OR source-target
+    pairs (pairs read as 2-element groups), whichever the line carries —
+    ``None`` for neither. Kept bit-compatible with the pre-ISSUE-14
+    ircheck parser so the cross-tier classification cannot move."""
+    m = _REPLICA_GROUPS.search(hlo_line) or _SOURCE_TARGETS.search(hlo_line)
+    if m:
+        return _int_groups(m.group(1))
+    return parse_replica_groups(hlo_line)
+
+
+def permutation_defect(
+    pairs: List[Tuple[int, int]], n_dev: Optional[int] = None
+) -> Optional[str]:
+    """Why a ``source_target_pairs`` list is NOT a permutation of its
+    axis group — the SL502 ppermute arm. ``None`` = congruent. A
+    *partial* permutation over a subset is fine as long as the senders
+    and receivers are the same devices (the odd-even sort rounds swap
+    disjoint partner pairs); the hang shapes are: a duplicate source
+    (undefined), a duplicate target (two blocks, one buffer), an id
+    outside the mesh, and a source/receiver mismatch (some device waits
+    for a block that never leaves, or sends into a peer that never
+    posted a receive)."""
+    if not pairs:
+        return None
+    sources = [s for s, _ in pairs]
+    targets = [t for _, t in pairs]
+    if len(set(sources)) != len(sources):
+        dup = sorted({s for s in sources if sources.count(s) > 1})
+        return f"duplicate source device(s) {dup} in source_target_pairs"
+    if len(set(targets)) != len(targets):
+        dup = sorted({t for t in targets if targets.count(t) > 1})
+        return f"duplicate target device(s) {dup} in source_target_pairs"
+    if n_dev:
+        out = sorted({i for i in sources + targets if i < 0 or i >= n_dev})
+        if out:
+            return f"device id(s) {out} outside the {n_dev}-device mesh"
+    if set(sources) != set(targets):
+        waiting = sorted(set(targets) - set(sources))
+        silent = sorted(set(sources) - set(targets))
+        return (
+            f"pairs are not a permutation of the axis group: device(s) "
+            f"{waiting or silent} receive without sending (or send without "
+            "receiving) — the ring never closes"
+        )
+    return None
+
+
+def partition_defect(
+    groups: List[List[int]], n_dev: Optional[int] = None
+) -> Optional[str]:
+    """Why a ``replica_groups`` list does NOT partition the mesh — the
+    SL502 grouped-collective arm. ``None`` = congruent. Every device
+    must appear in exactly one group (XLA's contract for grouped
+    collectives): a device in two groups issues twice, a device in none
+    never matches its peers' collective — both are hangs on TPU, not
+    errors. With ``n_dev`` unknown (no ``num_partitions`` header) only
+    duplication is checked, never coverage — conservative."""
+    if not groups:
+        return None
+    flat = [i for g in groups for i in g]
+    if len(set(flat)) != len(flat):
+        dup = sorted({i for i in flat if flat.count(i) > 1})
+        return f"device(s) {dup} appear in more than one replica group"
+    if n_dev:
+        out = sorted({i for i in flat if i < 0 or i >= n_dev})
+        if out:
+            return f"device id(s) {out} outside the {n_dev}-device mesh"
+        missing = sorted(set(range(n_dev)) - set(flat))
+        if missing:
+            return (
+                f"replica groups do not partition the mesh: device(s) "
+                f"{missing} belong to no group and never match the "
+                "collective their peers issued"
+            )
+    return None

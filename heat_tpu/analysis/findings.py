@@ -100,6 +100,30 @@ RULES: Dict[str, str] = {
              "object — the silent-swallow shape that turns a failover "
              "path's error into a hang: the client's future never "
              "resolves and no supervisor ever hears about the failure",
+    "SL501": "divergent-collective: a collective under a lax.cond/while "
+             "predicate not provably replicated across the shard_map "
+             "devices — devices branch apart (or exit the loop on "
+             "different iterations) and the collective never matches: on "
+             "TPU that is a silent hang, not an error. Make the predicate "
+             "a full-axis reduction of the local condition",
+    "SL502": "incomplete-permute: a compiled collective whose group "
+             "structure is incongruent — ppermute source_target_pairs "
+             "that are not a permutation of the axis group, or "
+             "replica_groups that do not partition the mesh — some device "
+             "waits forever. Documented ring schedules and plan-stamped "
+             "programs downgrade to info (boundaries machinery)",
+    "SL503": "collective-order-divergence: two collectives whose "
+             "inter-device issue order can differ — error on a "
+             "cross-group dependency cycle in the per-axis-group channel "
+             "graph (divergent cond branches issuing matched collectives "
+             "in opposite orders), warning on unordered independent "
+             "collectives over partially overlapping group partitions",
+    "SL504": "unfenced-entry: an executor/dispatcher entry point that "
+             "issues collectives without the WorldChangedError "
+             "epoch-fence check (elastic.check_world/check_epoch) "
+             "reachable on entry — work dispatched across a world "
+             "re-resolution hangs instead of failing typed "
+             "(commcheck.FENCED_DISPATCH_MODULES scopes the rule)",
 }
 
 

@@ -122,28 +122,57 @@ def _trace_errors():
     )
 
 
-_REPLICA_GROUPS = re.compile(r"replica_groups=\{((?:\{[0-9, ]*\},?)+)\}")
-_REPLICA_IOTA = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+)\]")
-_SOURCE_TARGETS = re.compile(r"source_target_pairs=\{((?:\{[0-9, ]*\},?)+)\}")
-_GROUP = re.compile(r"\{([0-9, ]*)\}")
+def _lower_checked(fn, args, kwargs, findings: List[Finding]):
+    """Trace and compile-only lower the checked program — the ONE
+    definition of the trace-abort contract shared by every pass entry
+    (``check``, ``commcheck``): a host-read abort appends an SL106
+    finding and returns ``None``, so the entry points can never drift
+    on which malformed programs produce a report instead of a raise.
+    Returns ``(closed_jaxpr, compiled)`` on success."""
+    import jax
+
+    from ..observability.hlo import _build_traceable
+
+    kind, target, traced_in = _build_traceable(fn, args, kwargs)
+    try:
+        if kind == "lower":
+            try:
+                closed = jax.make_jaxpr(target)(*args, **kwargs)
+            except TypeError:
+                # make_jaxpr traces EVERY argument; a jitted fn with
+                # static (non-array) args needs the AOT trace, which
+                # respects the jit's own static_argnums
+                closed = target.trace(*args, **kwargs).jaxpr
+            compiled = target.lower(*args, **kwargs).compile()
+        else:
+            closed = jax.make_jaxpr(target)(*traced_in)
+            # compile-only lowering of the CHECKED program — never
+            # dispatched, so ht.jit's hooks have nothing to observe here
+            compiled = jax.jit(target).lower(*traced_in).compile()  # shardlint: ignore[SL202]
+    except _trace_errors() as e:
+        findings.append(
+            Finding(
+                "SL106",
+                "error",
+                "trace aborted: the program reads device VALUES on the host "
+                f"(concretization) — {type(e).__name__}: {str(e).splitlines()[0]}",
+            )
+        )
+        return None
+    except TypeError as e:
+        if "ht.jit" in str(e) and "host" in str(e):
+            findings.append(
+                Finding("SL106", "error", f"trace aborted by a host read: {e}")
+            )
+            return None
+        raise
+    return closed, compiled
 
 
-def _parse_groups(hlo_line: str) -> Optional[list]:
-    """The replica groups (or ppermute source-target pairs) of one HLO
-    collective line, as lists of device ids — ``None`` when the line
-    carries neither form (conservative: no SL107 finding)."""
-    m = _REPLICA_GROUPS.search(hlo_line) or _SOURCE_TARGETS.search(hlo_line)
-    if m:
-        return [
-            [int(v) for v in g.split(",") if v.strip()]
-            for g in _GROUP.findall(m.group(1))
-        ]
-    m = _REPLICA_IOTA.search(hlo_line)
-    if m:
-        rows, cols, total = int(m.group(1)), int(m.group(2)), int(m.group(3))
-        if rows * cols == total:
-            return [list(range(r * cols, (r + 1) * cols)) for r in range(rows)]
-    return None
+# ONE parser (analysis/_groups.py, ISSUE 14) shared with commcheck's
+# SL502/SL503 congruence rules — the cross-tier and the incongruent
+# verdicts can never disagree about what the same HLO line says
+from ._groups import parse_groups as _parse_groups
 
 
 def check(
@@ -190,7 +219,6 @@ def check(
 
     from ..observability.hlo import (
         _COLLECTIVE_LINE,
-        _build_traceable,
         _count_ops,
         _shaped_bytes,
     )
@@ -205,39 +233,10 @@ def check(
 
         findings += scan_program_source(fn)
 
-    kind, target, traced_in = _build_traceable(fn, args, kwargs)
-    try:
-        if kind == "lower":
-            try:
-                closed = jax.make_jaxpr(target)(*args, **kwargs)
-            except TypeError:
-                # make_jaxpr traces EVERY argument; a jitted fn with
-                # static (non-array) args needs the AOT trace, which
-                # respects the jit's own static_argnums
-                closed = target.trace(*args, **kwargs).jaxpr
-            compiled = target.lower(*args, **kwargs).compile()
-        else:
-            closed = jax.make_jaxpr(target)(*traced_in)
-            # compile-only lowering of the CHECKED program — never
-            # dispatched, so ht.jit's hooks have nothing to observe here
-            compiled = jax.jit(target).lower(*traced_in).compile()  # shardlint: ignore[SL202]
-    except _trace_errors() as e:
-        findings.append(
-            Finding(
-                "SL106",
-                "error",
-                "trace aborted: the program reads device VALUES on the host "
-                f"(concretization) — {type(e).__name__}: {str(e).splitlines()[0]}",
-            )
-        )
+    lowered = _lower_checked(fn, args, kwargs, findings)
+    if lowered is None:
         return AnalysisReport(findings, context)
-    except TypeError as e:
-        if "ht.jit" in str(e) and "host" in str(e):
-            findings.append(
-                Finding("SL106", "error", f"trace aborted by a host read: {e}")
-            )
-            return AnalysisReport(findings, context)
-        raise
+    closed, compiled = lowered
 
     # ---- SL401: use-after-donate (pass 4 folded into the IR check) ----
     from .effectcheck import scan_jaxpr_donation
@@ -255,6 +254,13 @@ def check(
 
     text = compiled.as_text()
     context["collective_counts"] = {k: v for k, v in _count_ops(text).items() if v}
+
+    # ---- SL501-SL503: collective congruence (pass 5 folded in) --------
+    from .commcheck import scan_hlo_congruence, scan_jaxpr_divergence
+
+    _label = getattr(fn, "__name__", "") or ""
+    findings += scan_jaxpr_divergence(closed, label=_label)
+    findings += scan_hlo_congruence(text)
 
     # ---- SL101 / SL102: large resharding collectives -------------------
     from .boundaries import (

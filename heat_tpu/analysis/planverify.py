@@ -53,6 +53,20 @@ well-formed, invariant by invariant:
     recompute, the resident working set plus the slab peak fits
     ``tiers.capacity("hbm")``, and the annotation's lattice time model
     (``tiers.transfer_time`` over the pcie/hbm edges) is reproduced.
+``progress``
+    the collective-congruence replay (ISSUE 14, pass 5's dynamic half):
+    a symbolic per-device execution of the schedule proving every
+    participant can RUN it to completion — every collective step's
+    group structure is congruent across participants (hierarchical
+    ici/dcn pairs ride partitions of the mesh, ``S·C == p``), every
+    ring closes in exactly ``p-1`` hops (the replay delivers all ``p``
+    blocks), each hierarchical lap's intra/inter halves carry the SAME
+    chunk index (a split pair leaves one tier waiting on an unissued
+    lap), and every depth-2 overlap group issues its laps in exactly
+    the order the double-buffer consumes them (``0..laps-1`` — a
+    reordered lap makes the consume slot read an unissued buffer).
+    Available standalone as :func:`check_progress` — what the MPMD
+    stage-graph verifier will consume per stage.
 ``plan-id``
     the ``plan_id`` is the sha1 of the canonical serialization — a
     hand-edited or bit-rotted dump cannot keep its id.
@@ -69,7 +83,7 @@ import json
 
 from typing import Any, Dict, List, Optional, Tuple, Union
 
-__all__ = ["PlanVerificationError", "verify_plan"]
+__all__ = ["PlanVerificationError", "check_progress", "verify_plan"]
 
 _COLLECTIVE_KINDS = ("all_to_all", "all_gather", "ppermute")
 _LOCAL_KINDS = (
@@ -91,7 +105,7 @@ class PlanVerificationError(ValueError):
     invariant : the violated invariant's name (``composition``,
         ``conservation``, ``accounting``, ``quant-pairing``,
         ``tier-labels``, ``overlap-structure``, ``staging``,
-        ``plan-id``, ``step-kinds``).
+        ``progress``, ``plan-id``, ``step-kinds``).
     detail : what exactly failed, with the offending numbers.
     plan_id : the plan's id when known.
     """
@@ -886,6 +900,10 @@ def verify_plan(
                     f"recompute {want} (tiers.transfer_time arithmetic)",
                 )
 
+    # ---- progress: the collective-congruence replay (ISSUE 14) --------
+    for _rule, defect in _progress_defects(d, steps, coll, p, strategy, topo):
+        fail("progress", defect)
+
     # ---- plan-id: the sha1 of the canonical serialization -------------
     if plan_id is not None:
         stripped = {k: v for k, v in d.items() if k != "plan_id"}
@@ -901,7 +919,7 @@ def verify_plan(
     checks = [
         "step-kinds", "accounting", "quant-pairing", "tier-labels",
         "composition", "conservation", "overlap-structure", "staging",
-        "plan-id",
+        "progress", "plan-id",
     ]
     return {
         "ok": not violations,
@@ -912,3 +930,149 @@ def verify_plan(
             {"invariant": v.invariant, "detail": v.detail} for v in violations
         ],
     }
+
+
+# --------------------------------------------------------------------- #
+# the progress replay (ISSUE 14 — pass 5's dynamic half)                #
+# --------------------------------------------------------------------- #
+def _progress_defects(
+    d: Dict[str, Any],
+    steps: List[Dict[str, Any]],
+    coll: List[Dict[str, Any]],
+    p: int,
+    strategy: str,
+    topo: Optional[Dict[str, Any]],
+) -> List[Tuple[str, str]]:
+    """Symbolically replay one schedule per device and return every way
+    it fails to make progress, as ``(rule, detail)`` pairs (SL502 for
+    incongruent group structure, SL503 for issue-order defects; empty =
+    every participant runs the plan to completion). Pure arithmetic over
+    the plan dict — no mesh, no jax."""
+    defects: List[Tuple[str, str]] = []
+
+    # group congruence: every tiered collective's implied subgroup
+    # structure must partition the mesh — the hierarchical ici half
+    # rides S groups of C chips, the dcn half C groups of S same-index
+    # chips; both partition iff S·C == p
+    if topo is not None:
+        S, C = int(topo.get("n_slices", 0)), int(topo.get("chips_per_slice", 0))
+        if S * C != p or S < 2 or C < 1:
+            defects.append((
+                "SL502",
+                f"group congruence broken: topology {S}x{C} does not "
+                f"partition the {p}-device mesh — the subgroup collectives "
+                "can never match across participants",
+            ))
+
+    # ring closure: after hop d every device holds the block of the
+    # member d positions behind it; the ring closes iff the p-1 hops
+    # deliver all p distinct offsets
+    if strategy == "ring":
+        hops = [st for st in steps if st.get("kind") == "ppermute"]
+        delivered = {0} | {(k + 1) % p for k in range(len(hops))}
+        if len(hops) != p - 1 or len(delivered) != p:
+            defects.append((
+                "SL502",
+                f"ring does not close: {len(hops)} hop(s) deliver "
+                f"{len(delivered)} of the {p} blocks — exactly p-1={p - 1} "
+                "hops close the ring; any other count leaves a device "
+                "waiting on a block that never arrives",
+            ))
+
+    # hierarchical lap pairing: each lap's intra-slice (ici) and
+    # inter-slice (dcn) halves must carry the SAME chunk index — a
+    # split pair means one tier's exchange consumes a lap the other
+    # tier has not issued. Paired BY TIER LABEL, not raw step index, so
+    # an untiered collective (a warmup gather, a tail flush) can never
+    # shift the pairing frame and false-fail every following lap
+    if strategy == "hierarchical-a2a":
+        ici = [st for st in coll if st.get("tier") == "ici"]
+        dcn = [st for st in coll if st.get("tier") == "dcn"]
+        if len(ici) != len(dcn):
+            defects.append((
+                "SL502",
+                f"hierarchical lap pairing broken: {len(ici)} intra-slice "
+                f"(ici) half(s) vs {len(dcn)} inter-slice (dcn) half(s) — "
+                "every lap's ici pivot needs exactly one dcn exchange",
+            ))
+        else:
+            for k, (si, sd) in enumerate(zip(ici, dcn)):
+                ci, cd = si.get("chunk"), sd.get("chunk")
+                if ci != cd:
+                    defects.append((
+                        "SL502",
+                        f"hierarchical lap pairing broken: intra-slice half "
+                        f"of lap {k} carries chunk {ci!r} but its "
+                        f"inter-slice half carries chunk {cd!r} — the dcn "
+                        "exchange would consume a lap the ici pivot has not "
+                        "issued",
+                    ))
+                    break
+
+    # depth-2 lap replay: each overlap group's tagged laps must be
+    # issued in exactly the order the double buffer consumes them
+    # (consume of lap k-1 happens at issue of lap k: any gap, dup, or
+    # reorder makes the consume slot read an unissued buffer)
+    overlap = d.get("overlap")
+    if overlap:
+        lap_mult = 2 if strategy == "hierarchical-a2a" else 1
+        for g in overlap.get("groups") or []:
+            tag = g.get("tag")
+            tagged = [
+                st
+                for st in steps
+                if st.get("kind") in _COLLECTIVE_KINDS and st.get("overlap") == tag
+            ]
+            units = [
+                tagged[i * lap_mult : (i + 1) * lap_mult]
+                for i in range(len(tagged) // lap_mult)
+            ]
+            for i, unit in enumerate(units):
+                chunks = {u.get("chunk") for u in unit}
+                if len(chunks) > 1:
+                    defects.append((
+                        "SL503",
+                        f"overlap group {tag!r} lap {i} spans chunks "
+                        f"{sorted(chunks, key=repr)} — one lap unit must be "
+                        "one chunk",
+                    ))
+            lap_chunks = [u[0].get("chunk") for u in units if u]
+            if any(c is not None for c in lap_chunks):
+                want = list(range(len(units)))
+                if lap_chunks != want:
+                    defects.append((
+                        "SL503",
+                        f"overlap group {tag!r} issues laps in chunk order "
+                        f"{lap_chunks} — the depth-2 double buffer consumes "
+                        f"lap k-1 at issue of lap k, so the order must be "
+                        f"{want}; as recorded, a consume slot would read an "
+                        "unissued lap",
+                    ))
+    return defects
+
+
+def check_progress(plan) -> list:
+    """The plan-side collective-congruence check (pass 5's dynamic
+    half), standalone: replay one Schedule (or plan dict / canonical
+    JSON line) per device and return error-severity findings (SL502
+    for incongruent group structure, SL503 for issue-order defects) for
+    every progress defect — empty means every participant provably runs
+    the plan to completion. The same replay gates ``verify_plan`` under the
+    ``progress`` invariant; this entry point mirrors
+    :func:`~heat_tpu.analysis.effectcheck.check_plan_protocol` so the
+    golden-plan sweeps (and the future MPMD stage-graph verifier) can
+    collect findings instead of catching exceptions."""
+    from .findings import Finding
+
+    d = _as_plan_dict(plan)
+    steps = list(d.get("steps") or [])
+    coll = [st for st in steps if st.get("kind") in _COLLECTIVE_KINDS]
+    p = int((d.get("spec") or {}).get("mesh_size", 1))
+    defects = _progress_defects(
+        d, steps, coll, p, d.get("strategy", ""), d.get("topology")
+    )
+    plan_id = d.get("plan_id")
+    return [
+        Finding(rule, "error", f"plan {plan_id}: {defect}")
+        for rule, defect in defects
+    ]

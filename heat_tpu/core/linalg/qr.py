@@ -144,7 +144,9 @@ def _tsqr_fn(
         if not two_level:
             i = jax.lax.axis_index(axis_name)
             if ring:
-                rs = ring_gather(r1, p, i, [(ss, (ss + 1) % p) for ss in range(p)])
+                # the complete flat p-ring (one source/target per device
+                # — the SL502 congruence contract, built in one place)
+                rs = ring_gather(r1, p, i, _cm.grouped_ring_perm(1, p))
             else:
                 rs = jax.lax.all_gather(r1, axis_name)  # (p, k, cols)
             q2, r = jnp.linalg.qr(rs.reshape(-1, rs.shape[-1]), mode="reduced")
@@ -159,8 +161,7 @@ def _tsqr_fn(
         j = i % s    # position within group
         # level 1: gather the s member R's within each group
         if ring:
-            perm1 = [(gg * s + jj, gg * s + (jj + 1) % s) for gg in range(G) for jj in range(s)]
-            rs1 = ring_gather(r1, s, j, perm1)
+            rs1 = ring_gather(r1, s, j, _cm.grouped_ring_perm(G, s))
         else:
             groups1 = [[gg * s + jj for jj in range(s)] for gg in range(G)]
             rs1 = jax.lax.all_gather(r1, axis_name, axis_index_groups=groups1)
@@ -169,8 +170,7 @@ def _tsqr_fn(
         # level 2: every group's R_g is replicated within the group, so
         # gathering across same-j columns hands every device all G of them
         if ring:
-            perm2 = [(gg * s + jj, ((gg + 1) % G) * s + jj) for gg in range(G) for jj in range(s)]
-            rs2 = ring_gather(r_g, G, g, perm2)
+            rs2 = ring_gather(r_g, G, g, _cm.grouped_ring_perm(G, s, across=True))
         else:
             groups2 = [[gg * s + jj for gg in range(G)] for jj in range(s)]
             rs2 = jax.lax.all_gather(r_g, axis_name, axis_index_groups=groups2)

@@ -50,11 +50,39 @@ from jax import lax
 from typing import List, Tuple
 
 __all__ = [
+    "grouped_ring_perm",
     "ring_enabled",
     "ring_all_gather",
     "ring_matmul_reduce",
     "stamp_scope",
 ]
+
+
+def grouped_ring_perm(
+    n_groups: int, group_size: int, across: bool = False
+) -> List[Tuple[int, int]]:
+    """The COMPLETE +1 ring permutation of a grouped gather — every
+    device of the mesh appears exactly once as a source and once as a
+    target, which is the congruence contract commcheck's SL502 rule
+    verifies (a dropped pair leaves one device waiting on a block that
+    never leaves: a silent hang, not an error).
+
+    ``across=False`` rotates WITHIN each of the ``n_groups`` contiguous
+    groups of ``group_size`` (the two-level TSQR's level-1 member
+    gather); ``across=True`` rotates same-position members ACROSS the
+    groups (the level-2 group-R gather). ``grouped_ring_perm(1, p)`` is
+    the flat p-ring."""
+    if across:
+        return [
+            (g * group_size + j, ((g + 1) % n_groups) * group_size + j)
+            for g in range(n_groups)
+            for j in range(group_size)
+        ]
+    return [
+        (g * group_size + j, g * group_size + (j + 1) % group_size)
+        for g in range(n_groups)
+        for j in range(group_size)
+    ]
 
 
 def ring_enabled() -> bool:

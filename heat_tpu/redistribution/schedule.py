@@ -422,6 +422,63 @@ class Schedule:
                 out["pcie"] = out.get("pcie", 0) + s.bytes_moved
         return out
 
+    # ------------------------------------------------------------------ #
+    # congruence hooks (ISSUE 14): the per-step group structure the     #
+    # progress replay (ht.analysis.check_progress / verify_plan's       #
+    # ``progress`` invariant) reasons over. Properties/methods only —   #
+    # like the liveness hooks, they never touch the canonical           #
+    # serialization, so plan bytes and plan_ids are unchanged.          #
+    # ------------------------------------------------------------------ #
+    def collective_group_structure(self) -> List[Dict[str, Any]]:
+        """Per-collective-step symbolic group structure: ``{"kind",
+        "tier", "chunk", "n_groups", "group_size"}`` — the subgroup
+        shape each collective's participants must agree on. Flat plans
+        ride ONE group of ``mesh_size``; at a hierarchical topology the
+        ``ici`` halves ride ``n_slices`` groups of ``chips_per_slice``
+        and the ``dcn`` halves ``chips_per_slice`` groups of
+        ``n_slices`` — both partitions of the mesh by construction
+        (``S·C == p``), which is exactly what the progress replay
+        re-proves on dumped plans (and what the MPMD stage-graph
+        verifier will consume per stage)."""
+        p = int(self.spec.mesh_size)
+        S = C = None
+        if self.topology:
+            S = int(self.topology["n_slices"])
+            C = int(self.topology["chips_per_slice"])
+        out: List[Dict[str, Any]] = []
+        for s in self.steps:
+            if not s.is_collective:
+                continue
+            if s.tier == "ici" and S is not None:
+                n_groups, group_size = S, C
+            elif s.tier == "dcn" and S is not None and self.strategy == "hierarchical-a2a":
+                n_groups, group_size = C, S
+            else:
+                n_groups, group_size = 1, p
+            out.append(
+                {
+                    "kind": s.kind,
+                    "tier": s.tier,
+                    "chunk": s.chunk,
+                    "n_groups": n_groups,
+                    "group_size": group_size,
+                }
+            )
+        return out
+
+    def overlap_lap_chunks(self, tag: str) -> List[Optional[int]]:
+        """The chunk indices of one overlap group's collective laps, in
+        issue order (a hierarchical lap's ici/dcn pair contributes one
+        entry). The depth-2 double buffer consumes lap k-1 at issue of
+        lap k, so a well-formed group reads ``[0, 1, ..., laps-1]`` (or
+        all ``None`` for the ring's positional hops) — the invariant
+        the progress replay checks on every golden dump."""
+        lap_mult = 2 if self.strategy == "hierarchical-a2a" else 1
+        tagged = [s for s in self.steps if s.is_collective and s.overlap == tag]
+        return [
+            tagged[i * lap_mult].chunk for i in range(len(tagged) // lap_mult)
+        ]
+
     def collective_counts(self) -> Dict[str, int]:
         """{HLO op name: count} the executed program must launch —
         directly comparable with

@@ -47,6 +47,8 @@ __all__ = [
     "WorldChangedError",
     "WorldEvent",
     "WorldWatcher",
+    "capture_epoch",
+    "check_epoch",
     "check_world",
     "drain_and_rewarm",
     "elastic_fit",
@@ -220,6 +222,35 @@ def check_world(comm) -> None:
     raise WorldChangedError(
         "stale-epoch communicator", old_size=getattr(comm, "size", None),
         new_size=len(_comm_mod.get_comm().devices), epoch=e,
+    )
+
+
+def capture_epoch() -> int:
+    """The current world epoch as an opaque token for OBJECT-level
+    fencing (ISSUE 14): a dispatch-side artifact built against the
+    current world (a serving ``Endpoint``'s bucket programs, a future
+    MPMD stage program) records this at construction and hands it back
+    to :func:`check_epoch` on every issue. The communicator-level
+    :func:`stamp`/:func:`check_world` pair fences the redistribution
+    executor; this pair fences entry points that hold compiled programs
+    rather than a communicator."""
+    return _EPOCH
+
+
+def check_epoch(token: Optional[int], what: str = "dispatch") -> None:
+    """The entry fence for epoch-token holders (commcheck rule SL504's
+    sanctioned shape next to ``check_world``): zero-cost — one module
+    flag check — until the elastic runtime stamps a communicator, a
+    no-op under ``HEAT_TPU_RESILIENCE=0``; on a stale token it raises
+    the typed :class:`WorldChangedError` instead of letting the held
+    programs hang on devices that are gone."""
+    if not _ANY_STAMPED or token is None or token == _EPOCH:
+        return
+    if not _ckpt.resilience_enabled(explicit=True):
+        return
+    raise WorldChangedError(
+        f"stale-epoch {what}",
+        new_size=len(_comm_mod.get_comm().devices), epoch=token,
     )
 
 

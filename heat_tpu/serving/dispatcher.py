@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from .admission import AdmissionControl, ServingOverloaded
 from . import aot_cache as _aot
 from ..observability import telemetry as _telemetry
+from ..resilience import elastic as _elastic
 
 __all__ = ["Dispatcher", "Endpoint", "estimator_endpoint", "program_endpoint"]
 
@@ -107,6 +108,14 @@ class Endpoint:
         self.static_peak_bytes = (
             None if static_peak_bytes is None else int(static_peak_bytes)
         )
+        # epoch fence (ISSUE 14, commcheck SL504): the bucket programs
+        # are compiled against THIS world — record its epoch so a
+        # dispatch racing a world re-resolution fails typed
+        # (WorldChangedError) instead of hanging on devices that are
+        # gone. Zero-cost until the elastic runtime engages; the
+        # drain/resume contract swaps in a re-warmed Endpoint whose
+        # token is fresh.
+        self._world_token = _elastic.capture_epoch()
 
     @property
     def max_rows(self) -> int:
@@ -121,6 +130,7 @@ class Endpoint:
     def run(self, batch: np.ndarray):
         """Pad to bucket, place, and issue (asynchronously) the bucket's
         program. Returns ``(out, rows)``."""
+        _elastic.check_epoch(self._world_token, what=f"endpoint {self.name!r}")
         rows = batch.shape[0]
         bucket = self.bucket_for(rows)
         if bucket > rows:

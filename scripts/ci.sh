@@ -172,25 +172,33 @@ HEAT_TPU_RESILIENCE=0 python -m pytest tests/test_resilience.py -q "$@"
 echo "HEAT_TPU_RESILIENCE=0: golden dumps byte-identical + escape-hatch pins clean"
 rm -f "$res_a" "$res_b"
 
-python scripts/lint.py heat_tpu/ --pass srclint
+# the single CI lint entry (ISSUE 14): passes 2 + 4 + 5 — srclint
+# (SL2xx source hygiene), effectcheck (SL40x gate/cache-key staleness,
+# raw gate reads, lock discipline, pipeline protocol, swallowed worker
+# exceptions) and commcheck (SL504 unfenced dispatch entries) — in ONE
+# process, gated at error severity, with one SARIF document carrying
+# one run per pass for CI annotations. Exit codes are pinned
+# format-invariant (tests/test_analysis.py::TestLintCLI): 0 on the
+# clean tree, 1 on any error-severity finding, text or sarif alike.
+python scripts/lint.py heat_tpu/ --pass all
+python scripts/lint.py heat_tpu/ --pass all --format sarif > /dev/null
+echo "lint --pass all: SL2xx/SL4xx/SL5xx clean + SARIF emitted"
 
-# pass-4 leg (ISSUE 12): gatecheck + racecheck over the tree at error
-# severity — gate/cache-key staleness (SL402), raw HEAT_TPU_* reads
-# bypassing the registry (SL403), lock-discipline races in the threaded
-# modules (SL404), the depth-2 issue/consume protocol (SL405), and the
-# swallowed-worker-exception failover hazard (SL406, ISSUE 13) — plus
-# the SARIF emission CI annotations consume
-python scripts/lint.py heat_tpu/ --pass effectcheck
-python scripts/lint.py heat_tpu/ --pass effectcheck --format sarif > /dev/null
-echo "effectcheck: SL4xx clean + SARIF emitted"
+# seeded-bug proof (ISSUE 12 + 14 acceptance): each mutation removes
+# ONE invariant — a gate from a program-cache key (SL402), a lock
+# acquisition from a guarded dispatcher path (SL404), a pair from a
+# ring_all_gather permutation (SL502), the full-axis reduction off a
+# collective-launching cond predicate (SL501), the epoch-fence call
+# off the executor / the serving endpoint (SL504) — and the lint must
+# trip on the mutated source with the invariant named.
+python -m pytest tests/test_effectcheck.py tests/test_commcheck.py -q -k "mutation" "$@"
 
-# seeded-bug proof (ISSUE 12 acceptance): each mutation removes ONE
-# invariant — a gate from a program-cache key (SL402: the builder then
-# reads the gate ambiently), a lock acquisition from a guarded
-# dispatcher path (SL404) — and the pass-4 lint must trip at error on
-# the mutated source. tests/test_effectcheck.py runs the same
-# mutations per-rule with the invariant named.
-python -m pytest tests/test_effectcheck.py -q -k "mutation" "$@"
+# pass-5 IR + progress legs (ISSUE 14): the SL5xx golden bad fixtures
+# trip at their declared severities with clean twins, the shipped
+# collective contracts pin commcheck-clean, every golden plan replays
+# to completion under the progress invariant, and a hand-mutated dump
+# fails scripts/verify_plans.py NAMING "progress" (the sweep test).
+python -m pytest tests/test_commcheck.py -q "$@"
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
   python scripts/lint.py --ir-entry 8
@@ -208,7 +216,12 @@ XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
 # the violated invariant named. ISSUE 11 adds the staged golden plans
 # (host-staging window schedules) to every dump: the staging invariant
 # (stage pairing, window conservation, depth-2 slab occupancy, lattice
-# time model) is proven on each
+# time model) is proven on each. ISSUE 14 adds the progress invariant
+# to the same sweep: a symbolic per-device replay proving every
+# participant runs each plan to completion — congruent subgroup
+# structure, rings closing in exactly p-1 hops, hierarchical ici/dcn
+# lap pairs sharing one chunk, depth-2 lap tags issued in consume
+# order — so a dump that would HANG a mesh fails here, not on TPU
 plans_a="$(mktemp)"; plans_b="$(mktemp)"
 python scripts/redist_plans.py > "$plans_a"
 python scripts/redist_plans.py > "$plans_b"

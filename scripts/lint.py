@@ -22,8 +22,20 @@ Effect lint (pass 4) over the same tree::
   staleness against the ``heat_tpu.core.gates`` registry, raw
   ``HEAT_TPU_*`` env reads bypassing it, lock-discipline races in the
   threaded modules, and the depth-2 issue/consume pipeline protocol.
-  ``--pass all`` (the default when paths are given) runs passes 2 and 4
-  together.
+
+Comm lint (pass 5) over the same tree::
+
+    python scripts/lint.py heat_tpu/ --pass commcheck
+
+  The ``commcheck`` source rule (SL504): executor/dispatcher entry
+  points that issue collectives without the ``WorldChangedError``
+  epoch fence reachable on entry. (The IR rules SL501–SL503 ride
+  ``ht.analysis.check``/``ht.analysis.commcheck``; the plan-side
+  ``progress`` invariant rides ``scripts/verify_plans.py``.)
+
+  ``--pass all`` (the default when paths are given) is the single CI
+  lint entry (ISSUE 14): passes 2, 4 and 5 run in ONE process with one
+  SARIF document per run.
 
 IR lint (pass 1) over the driver training step::
 
@@ -135,12 +147,14 @@ def main() -> int:
     ap.add_argument(
         "--pass",
         dest="which",
-        choices=("srclint", "effectcheck", "all"),
+        choices=("srclint", "effectcheck", "commcheck", "all"),
         default="all",
         help="which source passes to run over the given paths: pass 2 "
         "(srclint, SL2xx), pass 4 (effectcheck, SL4xx: gate/cache-key "
         "staleness, raw gate reads, lock discipline, pipeline protocol), "
-        "or both (default)",
+        "pass 5 (commcheck, SL504: unfenced dispatch entries), or all "
+        "three in ONE process — the single CI lint entry (default; one "
+        "SARIF document with one run per pass)",
     )
     ap.add_argument(
         "--format",
@@ -173,6 +187,14 @@ def main() -> int:
         report = effectcheck.lint_paths(args.paths, root=ROOT)
         _print_report(report, "effectcheck", fmt)
         reports.append(("effectcheck", report))
+        gate |= not report.ok
+
+    if args.paths and args.which in ("commcheck", "all"):
+        from heat_tpu.analysis.commcheck import lint_paths as _commcheck_paths
+
+        report = _commcheck_paths(args.paths, root=ROOT)
+        _print_report(report, "commcheck", fmt)
+        reports.append(("commcheck", report))
         gate |= not report.ok
 
     if args.ir_entry is not None:

@@ -2,11 +2,17 @@
 """Sweep ``ht.analysis.verify_plan`` over dumped golden plans.
 
 The ci.sh determinism leg already proves the golden plan dumps
-(``scripts/redist_plans.py``: flat / 2x4 / 2x8, quant on+off) are
-byte-identical run-to-run; this script proves each dumped plan is
+(``scripts/redist_plans.py``: flat / 2x4 / 2x8, quant on+off, staged)
+are byte-identical run-to-run; this script proves each dumped plan is
 WELL-FORMED — composition, byte conservation, codec pairing, tier
-labels, overlap structure, plan-id integrity. A malformed plan fails
-the leg with the violated invariant named::
+labels, overlap structure, plan-id integrity, and (ISSUE 14) the
+``progress`` invariant: a symbolic per-device replay proving every
+participant runs the schedule to completion — congruent group
+structure, rings closing in exactly p-1 hops, hierarchical ici/dcn
+lap pairs sharing one chunk, depth-2 lap tags issued in exactly the
+order the double buffer consumes them. A malformed plan fails the leg
+with the violated invariant named (tests/test_commcheck.py proves a
+hand-mutated lap order fails here naming ``progress``)::
 
     python scripts/redist_plans.py > plans.txt
     python scripts/verify_plans.py plans.txt

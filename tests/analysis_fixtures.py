@@ -451,6 +451,256 @@ class LoggingSwallowWorker:
 '''
 
 
+# --------------------------------------------------------------------- #
+# pass 5 (ISSUE 14): commcheck golden bad fixtures                       #
+# --------------------------------------------------------------------- #
+def divergent_cond_collective_program(x):
+    """SL501: a ``lax.cond`` whose TRUE branch launches a full-axis psum
+    is predicated on ``axis_index`` — the device-identity source, never
+    replicated. Half the mesh enters the branch and issues the
+    collective, the other half skips it: on TPU the psum never matches
+    and the mesh hangs silently. The replication lattice proves the
+    predicate varying and trips at error."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from heat_tpu.core._jax_compat import shard_map
+
+    comm = x.comm
+    phys = x._phys
+
+    def body(xl):
+        i = lax.axis_index(comm.axis_name)
+        return lax.cond(
+            i < comm.size // 2,
+            lambda v: lax.psum(v, comm.axis_name),
+            lambda v: v * 2.0,
+            xl,
+        )
+
+    spec = P(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+    return shard_map(
+        body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    )(phys)
+
+
+def uniform_cond_collective_program(x):
+    """Clean twin of ``divergent_cond_collective_program`` — the fix the
+    SL501 message names: the predicate is a FULL-AXIS psum of the local
+    condition, so every device computes the same boolean and the
+    branches stay congruent."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from heat_tpu.core._jax_compat import shard_map
+
+    comm = x.comm
+    phys = x._phys
+
+    def body(xl):
+        agree = lax.psum((xl.sum() > 0.0).astype(jnp.float32), comm.axis_name)
+        return lax.cond(
+            agree > 0.0,
+            lambda v: lax.psum(v, comm.axis_name),
+            lambda v: v * 2.0,
+            xl,
+        )
+
+    spec = P(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+    return shard_map(
+        body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    )(phys)
+
+
+def divergent_while_collective_program(x):
+    """SL501 (while arm): the loop's continuation predicate reads the
+    LOCAL shard (each device's values differ), so devices exit on
+    different iterations — and the psum in the body stops matching on
+    the first iteration some device has already left."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from heat_tpu.core._jax_compat import shard_map
+
+    comm = x.comm
+    phys = x._phys
+
+    def body(xl):
+        def cond_fn(c):
+            return c[0] < c[1][0, 0]  # local-shard value: per-device trip count
+
+        def body_fn(c):
+            return c[0] + 1.0, lax.psum(c[1], comm.axis_name)
+
+        _, out = lax.while_loop(cond_fn, body_fn, (jnp.float32(0.0), xl))
+        return out
+
+    spec = P(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+    return shard_map(
+        body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    )(phys)
+
+
+def open_ring_program(x):
+    """SL502: a hand-rolled ppermute whose pairs DROP the wraparound
+    edge — ``(s, s+1)`` for ``s < p-1`` only. Device 0 sends but never
+    receives, device p-1 receives but never sends: the ring never
+    closes and the unmatched device waits forever. The congruence scan
+    reads the compiled ``source_target_pairs`` and trips at error; the
+    fix it names is ``kernels.cmatmul.grouped_ring_perm`` (the one
+    place the complete +1 ring is built)."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from heat_tpu.core._jax_compat import shard_map
+
+    comm = x.comm
+    p = comm.size
+    phys = x._phys
+
+    def body(xl):
+        return lax.ppermute(
+            xl, comm.axis_name, [(s, s + 1) for s in range(p - 1)]
+        )
+
+    spec = P(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+    return shard_map(
+        body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    )(phys)
+
+
+def opposite_order_collectives_program(x):
+    """SL503 (cycle arm, error): a DIVERGENT cond whose two branches
+    issue the same two full-axis collectives in OPPOSITE orders — psum
+    then pmax on one side, pmax then psum on the other. Devices taking
+    different branches each wait for the collective the other has not
+    issued yet: a cross-group dependency cycle in the channel graph
+    (also trips SL501 — the divergence is what arms the cycle)."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from heat_tpu.core._jax_compat import shard_map
+
+    comm = x.comm
+    phys = x._phys
+
+    def body(xl):
+        i = lax.axis_index(comm.axis_name)
+
+        def lo(v):
+            return lax.pmax(lax.psum(v, comm.axis_name), comm.axis_name)
+
+        def hi(v):
+            return lax.psum(lax.pmax(v, comm.axis_name), comm.axis_name)
+
+        return lax.cond(i < comm.size // 2, lo, hi, xl)
+
+    spec = P(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+    return shard_map(
+        body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    )(phys)
+
+
+def overlapping_groups_program(x):
+    """SL503 (independent arm, warning): two INDEPENDENT grouped psums
+    whose group partitions partially overlap — halves vs neighbor pairs
+    — with no dataflow ordering between them. Participants shared by
+    unequal groups may observe the two collectives in different issue
+    orders (the compiler is free to schedule them per-participant).
+    Requires an even mesh of >= 4 devices."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from heat_tpu.core._jax_compat import shard_map
+
+    comm = x.comm
+    p = comm.size
+    phys = x._phys
+    halves = [list(range(p // 2)), list(range(p // 2, p))]
+    pairs = [[2 * k, 2 * k + 1] for k in range(p // 2)]
+
+    def body(xl):
+        a = lax.psum(xl, comm.axis_name, axis_index_groups=halves)
+        b = lax.psum(xl * 2.0, comm.axis_name, axis_index_groups=pairs)
+        return a + b
+
+    spec = P(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+    return shard_map(
+        body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    )(phys)
+
+
+def aligned_groups_program(x):
+    """Clean twin of ``overlapping_groups_program`` — the fix the SL503
+    message names: both psums ride the SAME partition, so every
+    participant agrees on the group structure and order cannot
+    diverge."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from heat_tpu.core._jax_compat import shard_map
+
+    comm = x.comm
+    p = comm.size
+    phys = x._phys
+    halves = [list(range(p // 2)), list(range(p // 2, p))]
+
+    def body(xl):
+        a = lax.psum(xl, comm.axis_name, axis_index_groups=halves)
+        b = lax.psum(xl * 2.0, comm.axis_name, axis_index_groups=halves)
+        return a + b
+
+    spec = P(*(comm.axis_name if k == 0 else None for k in range(phys.ndim)))
+    return shard_map(
+        body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    )(phys)
+
+
+#: SL504: a dispatcher-shaped module whose public entry issues the
+#: bucket program with NO epoch fence reachable on its intra-module
+#: closure — work dispatched across a world re-resolution hangs on
+#: devices that are gone instead of failing typed. The clean twin below
+#: shows the sanctioned shape (``elastic.check_epoch`` on entry — the
+#: serving Endpoint's own idiom since ISSUE 14).
+UNFENCED_DISPATCH_SRC = '''
+import threading
+
+
+class BareEndpoint:
+    def __init__(self, programs):
+        self.programs = programs
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def run(self, batch, bucket):
+        return self.programs[bucket](batch)   # no fence on the entry path
+
+    def _worker(self):
+        self.run(None, 0)
+'''
+
+#: the fenced twin: one ``check_epoch`` call on the entry makes the
+#: whole intra-module closure fenced (same reachability SL402 uses).
+FENCED_DISPATCH_SRC = '''
+from heat_tpu.resilience.elastic import check_epoch
+
+
+class FencedEndpoint:
+    def __init__(self, programs):
+        self.programs = programs
+        self._token = None
+
+    def run(self, batch, bucket):
+        check_epoch(self._token, what="fixture endpoint")
+        return self.programs[bucket](batch)
+'''
+
+
 def serving_sync_handler(x):
     """SL106 (ISSUE 9): a serving request handler that reads device
     VALUES on the host mid-request — a debug/logging sync buried in the

@@ -493,10 +493,12 @@ class TestLintCLI(TestCase):
         self.assertEqual(ok.returncode, 0, ok.stdout + ok.stderr)
         doc = json.loads(ok.stdout)
         self.assertEqual(doc["version"], "2.1.0")
-        # one run per pass — the default runs pass 2 AND pass 4 (ISSUE 12)
+        # one run per pass — the default `--pass all` is the single CI
+        # lint entry (ISSUE 14): passes 2, 4 AND 5 in one process, one
+        # SARIF document with one run per pass
         self.assertEqual(
             [run["tool"]["driver"]["name"] for run in doc["runs"]],
-            ["shardlint/srclint", "shardlint/effectcheck"],
+            ["shardlint/srclint", "shardlint/effectcheck", "shardlint/commcheck"],
         )
         import tempfile
 

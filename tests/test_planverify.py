@@ -8,7 +8,9 @@ Contracts pinned here:
   ``scripts/verify_plans.py`` sweep consumes).
 - Every mutation class a malformed plan can carry is caught with the
   violated invariant NAMED: accounting, composition, conservation,
-  quant-pairing, tier-labels, overlap-structure, plan-id, step-kinds.
+  quant-pairing, tier-labels, overlap-structure, plan-id, step-kinds
+  (the ISSUE 14 ``progress`` invariant's mutation classes live in
+  tests/test_commcheck.py).
 - ``scripts/verify_plans.py`` exits 0 over a fresh dump and 1 over a
   corrupted one, naming the invariant — the CI leg's contract.
 - memcheck's static peak on the three GATED redistribution programs is
@@ -76,7 +78,7 @@ class TestGoldenMatrixVerifies(TestCase):
             self.assertIn(key, res)
         for inv in ("composition", "conservation", "accounting",
                     "quant-pairing", "tier-labels", "overlap-structure",
-                    "plan-id"):
+                    "progress", "plan-id"):
             self.assertIn(inv, res["checks"])
 
 
