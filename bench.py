@@ -812,6 +812,46 @@ def measure_heat_tpu() -> dict:
             f["quant"] = plan.quant["mode"]
         return f
 
+    def _attribution_fields(step, x, plan):
+        """ISSUE 15: one traced execution -> the model-vs-measured join.
+        Clears the executor program cache first so the per-lap trace
+        probes re-fire (census == plan structure), brackets the run in
+        a ``fenced`` span (the execute leg attribution judges against
+        the plan's modeled wall), and returns the compact diagnosis —
+        census + per-leg measured_s/model_error — that rides the row."""
+        import importlib
+
+        # the package attr `attribution` is the FUNCTION (the documented
+        # call shape); the module must come via importlib
+        _att = importlib.import_module("heat_tpu.observability.attribution")
+        from heat_tpu.observability import tracing as _tr
+        from heat_tpu.redistribution import executor as _rexec
+
+        was = _tr.enabled()
+        try:
+            _tr.enable()
+            _tr.clear()
+            _rexec.clear_program_cache()  # fresh trace: lap census fires
+            t0 = time.perf_counter()
+            sync(step(x))
+            t1 = time.perf_counter()
+            _tr.add_span(
+                "bench.execute", t0, t1,
+                plan_id=plan.plan_id, step="execute", fenced=True,
+            )
+            att = _att.attribution(plan)
+            return {
+                "model_wall_s": att["model"]["wall_s"],
+                "census": att["census"],
+                "legs": att["legs"],
+            }
+        except Exception:  # pragma: no cover — diagnosis must never take bench down
+            return {}
+        finally:
+            if not was:
+                _tr.disable()
+            _tr.clear()
+
     def _mem_fields(fn, *xs):
         # static memory bounds (ISSUE 10): the memcheck liveness peak
         # per device plus the compiler's own buffer-assignment numbers,
@@ -852,6 +892,9 @@ def measure_heat_tpu() -> dict:
         out["_reshape_plan"].update(
             _mem_fields(lambda y: ht.reshape(y, (10_000_000, -1), new_split=1), r)
         )
+        out["_reshape_plan"]["attribution"] = _attribution_fields(
+            lambda y: ht.reshape(y, (10_000_000, -1), new_split=1), r, plan
+        )
     except Exception:
         out["_reshape_plan"] = {}
     del r
@@ -878,6 +921,9 @@ def measure_heat_tpu() -> dict:
         out["_reshape_lane_plan"].update(
             _mem_fields(lambda y: ht.reshape(y, LANE_OUT, new_split=1), rl)
         )
+        out["_reshape_lane_plan"]["attribution"] = _attribution_fields(
+            lambda y: ht.reshape(y, LANE_OUT, new_split=1), rl, plan
+        )
     except Exception:
         out["_reshape_lane_plan"] = {}
     del rl
@@ -890,8 +936,12 @@ def measure_heat_tpu() -> dict:
     )
     method["resplit_1gb"] = "chained-slope (pair, halved; interleaved with the sequential twin)"
     try:
-        out["_resplit_plan"] = _plan_fields(ht.redistribution.explain(rsp, 1))
+        _rsp_plan = ht.redistribution.explain(rsp, 1)
+        out["_resplit_plan"] = _plan_fields(_rsp_plan)
         out["_resplit_plan"].update(_mem_fields(lambda y: y.resplit(1), rsp))
+        out["_resplit_plan"]["attribution"] = _attribution_fields(
+            lambda y: y.resplit(1), rsp, _rsp_plan
+        )
     except Exception:
         out["_resplit_plan"] = {}
     del rsp
@@ -1651,6 +1701,35 @@ def _serving_qps_row() -> dict:
     if (not ok or stats["requests"] != total + 1
             or stats["rejected"] or stats["shed"]):
         row["measurement_suspect"] = True
+    # ISSUE 15 attribution detail: a short TRACED drain after the
+    # measured one (tracing off during the clocked runs), reduced to
+    # the per-phase lifecycle breakdown — where a request's time went
+    # (queue vs dispatch vs fence vs resolve), p50/p95/p99 each
+    try:
+        import importlib
+
+        # the package attr `attribution` is the FUNCTION (the documented
+        # call shape); the module must come via importlib
+        _att = importlib.import_module("heat_tpu.observability.attribution")
+        from heat_tpu.observability import tracing as _tr
+
+        was = _tr.enabled()
+        try:
+            _tr.enable()
+            _tr.clear()
+            ep = srv.Endpoint({bucket: prog}, (d,), np.float32,
+                              extra_args=(centers,), name="bench-traced")
+            with srv.Dispatcher(ep, max_queue=32, poll_s=0.001) as disp:
+                futs = [disp.submit(payloads[0]) for _ in range(8)]
+                for f in futs:
+                    f.result(timeout=120)
+            row["attribution"] = _att.serving_breakdown()
+        finally:
+            if not was:
+                _tr.disable()
+            _tr.clear()
+    except Exception:  # pragma: no cover — diagnosis must never take bench down
+        pass
     return row
 
 

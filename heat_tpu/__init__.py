@@ -54,6 +54,7 @@ from . import spatial
 from . import utils
 from . import datasets
 from .observability import telemetry
+from .observability import tracing
 from .version import __version__
 
 

@@ -407,7 +407,8 @@ class KMeans(_KCluster):
 
         rng0 = self._rng_state
         try:
-            _staging.stream_windows(host, 0, wins[start:], consume, device_put=put)
+            _staging.stream_windows(host, 0, wins[start:], consume, device_put=put,
+                                    plan_id=sched.plan_id)
         except BaseException:
             if guarded:
                 # a failed guarded stream rewinds the model's private

@@ -320,6 +320,19 @@ declare(GateSpec(
          "changes no program bytes",
 ))
 declare(GateSpec(
+    "HEAT_TPU_TRACE", default="auto", values=("0", "1", "auto"),
+    affects_programs=False, scopes=(),
+    key_params=(),
+    accessors=("trace_mode", "enabled"),
+    help="span tracer + flight-recorder export switch "
+         "(observability.tracing): 0 = hard off (the zero-overhead "
+         "escape hatch — every probe is one module-bool read), 1 = "
+         "collect, auto = follow the telemetry switch. Records "
+         "host-side spans only — plans, plan_ids, programs, and AOT "
+         "envelope keys are byte-identical at every value "
+         "(affects_programs=False by construction, diffed in CI)",
+))
+declare(GateSpec(
     "HEAT_TPU_RESILIENCE", default="auto", values=("0", "1", "auto"),
     affects_programs=True, scopes=("aot",),
     key_params=(),

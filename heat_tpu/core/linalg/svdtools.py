@@ -644,7 +644,7 @@ def _staged_sketch_rank(host, keep: int, sketch_l: int, r_final: int, want: str,
             )
             chunks.append(w_k)
 
-        _staging.stream_windows(host, 1, wins, consume)
+        _staging.stream_windows(host, 1, wins, consume, plan_id=sched.plan_id)
         w_full = chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks, axis=1)
         return _staged_oneview_tail_fn(keep, l_row, r_final, want)(
             w_full, carry["y"], carry["norm"], g
@@ -658,7 +658,7 @@ def _staged_sketch_rank(host, keep: int, sketch_l: int, r_final: int, want: str,
     def consume1(k, slab_arr, win):
         chunks.append(_jit_pass1(g, _cast(slab_arr)))
 
-    _staging.stream_windows(host, 1, wins1, consume1)
+    _staging.stream_windows(host, 1, wins1, consume1, plan_id=sched.plan_id)
     w = chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks, axis=1)
     qw = _jit_orth_rows(w)
 
@@ -670,7 +670,7 @@ def _staged_sketch_rank(host, keep: int, sketch_l: int, r_final: int, want: str,
         z_k, carry2["norm"] = _jit_pass2(_cast(slab_arr), qw, carry2["norm"])
         zc.append(z_k)
 
-    _staging.stream_windows(host, 0, wins2, consume2)
+    _staging.stream_windows(host, 0, wins2, consume2, plan_id=sched.plan_id)
     z = zc[0] if len(zc) == 1 else jnp.concatenate(zc, axis=0)
     return _staged_rank_tail_fn(keep, r_final, want)(z, qw, carry2["norm"])
 

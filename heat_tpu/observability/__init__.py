@@ -2,15 +2,25 @@
 delegates ALL instrumentation to external tools — perun around its
 benchmark scripts, nothing inside the library).
 
-Three pieces, one import surface:
+Five pieces, one import surface:
 
 - :mod:`~heat_tpu.observability.telemetry` — process-wide counters,
-  timers (p50/p95) and the ``record()`` context manager; zero-cost when
-  disabled, ``HEAT_TPU_TELEMETRY=1`` or ``enable()`` to activate. Also
-  exposed as the ``ht.telemetry`` shorthand.
+  timers (p50/p95/p99), the ``record()`` context manager, and
+  :func:`prometheus_text` exposition; zero-cost when disabled,
+  ``HEAT_TPU_TELEMETRY=1`` or ``enable()`` to activate. Also exposed
+  as the ``ht.telemetry`` shorthand.
 - :mod:`~heat_tpu.observability.events` — bounded structured event log
   fed by the hooks in ``core/`` (shard/reshard bytes, program-cache
-  misses, ``ht.jit`` traces).
+  misses, ``ht.jit`` traces); overwrites counted, span-correlated.
+- :mod:`~heat_tpu.observability.tracing` — span tracing of the hot
+  layers (``ht.tracing.span``), the always-on flight recorder, and
+  Chrome-trace/Perfetto export (:func:`export_trace`); gated
+  ``HEAT_TPU_TRACE`` with ``affects_programs=False`` — plans, plan_ids,
+  programs, and AOT keys are byte-identical at every value.
+- :mod:`~heat_tpu.observability.attribution` — the model-vs-measured
+  join (:func:`attribution`): measured span time per step kind/tier
+  against the plan's ``tier_time_model``/overlap/staging annotations,
+  reported as per-leg ``model_error``.
 - :mod:`~heat_tpu.observability.hlo` — :func:`collective_counts`, the
   compile-only HLO inspector pinning each op's collective structure
   (the public form of the MULTICHIP dryrun asserts).
@@ -23,6 +33,8 @@ from . import events
 from . import hlo
 from . import instrument
 from . import telemetry
+from . import tracing
+from . import attribution
 
 from .hlo import COLLECTIVE_OPS, CollectiveReport, collective_counts
 from .telemetry import (
@@ -32,24 +44,36 @@ from .telemetry import (
     export_jsonl,
     inc,
     observe,
+    prometheus_text,
     record,
     report,
     reset,
     snapshot,
 )
+from .tracing import export_trace, flight_tail, span
+
+# `ht.observability.attribution(plan_id)` is the documented call shape:
+# the FUNCTION takes the package-attr slot, the module stays reachable
+# as `heat_tpu.observability.attribution` via sys.modules/importlib
+attribution = attribution.attribution
 
 __all__ = [
     "COLLECTIVE_OPS",
     "CollectiveReport",
+    "attribution",
     "collective_counts",
     "disable",
     "enable",
     "enabled",
     "export_jsonl",
+    "export_trace",
+    "flight_tail",
     "inc",
     "observe",
+    "prometheus_text",
     "record",
     "report",
     "reset",
     "snapshot",
+    "span",
 ]

@@ -1,0 +1,208 @@
+"""Model-vs-measured attribution — closing the loop the tracer opens
+(ISSUE 15).
+
+Every analytic bench row ships a MODEL (the lattice's
+``tier_time_model``, the overlap annotation's critical-path ratio, the
+staging annotation's depth-2 PCIe bound) and waits for a MEASUREMENT to
+judge it. This module performs the join: :func:`attribution` takes a
+plan (or its ``plan_id``), finds the spans the tracer recorded for it,
+groups measured wall time by step kind and tier, and reports per-leg
+``model_error`` — signed relative error ``measured/model - 1`` — so
+the first real-TPU round lands with its own diagnosis attached instead
+of a bare wall-clock number.
+
+Span semantics it relies on (see ``tracing``):
+
+- spans tagged ``traced=True`` fired during program TRACING (the
+  executor's per-lap probes): census material only — they are counted,
+  never timed;
+- untagged spans are real host wall time (staging windows, dispatcher
+  batches, checkpoint slabs);
+- spans tagged ``fenced=True`` bracket a fenced end-to-end execution
+  (bench wraps its timed runs this way): they feed the ``execute`` leg,
+  judged against the plan's modeled wall — the depth-2 critical path
+  when the plan carries an overlap/staging annotation, the sequential
+  tier sum otherwise.
+
+Plan lookup: the executor and ``plan_staged_passes`` register every
+schedule they touch in a small bounded registry here; the planner's
+own schedule cache is the fallback.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from typing import Any, Dict, List, Optional
+
+from . import tracing as _tracing
+
+__all__ = ["attribution", "register_plan", "serving_breakdown"]
+
+_PLAN_CAP = 512
+
+_plan_lock = threading.Lock()
+_plans: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
+
+#: measured-leg tiers the tier model prices directly
+_MODEL_TIERS = ("ici", "dcn", "pcie")
+
+
+def register_plan(sched) -> None:
+    """Remember a Schedule by plan_id so :func:`attribution` can find
+    it later (bounded LRU — attribution is a diagnosis tool, not a
+    plan store)."""
+    with _plan_lock:
+        _plans[sched.plan_id] = sched
+        _plans.move_to_end(sched.plan_id)
+        while len(_plans) > _PLAN_CAP:
+            _plans.popitem(last=False)
+
+
+def _lookup(plan_id: str):
+    with _plan_lock:
+        sched = _plans.get(plan_id)
+    if sched is not None:
+        return sched
+    # fallback: the planner's schedule cache (explain()/plan() route
+    # every redistribution plan through it)
+    from ..redistribution import planner as _planner
+
+    with _planner._plan_lock:
+        for s in _planner._plan_cache.values():
+            if s.plan_id == plan_id:
+                return s
+    raise KeyError(
+        f"attribution: no Schedule known for plan_id {plan_id!r} — execute "
+        "the plan (or call ht.redistribution.explain) with tracing enabled "
+        "first, or pass the Schedule object itself"
+    )
+
+
+def _modeled_wall_s(sched, model: Dict[str, Any]) -> float:
+    """The plan's modeled end-to-end wall: the depth-2 critical path
+    when it carries a staging/overlap annotation (their documented
+    convention), else the sequential tier sum."""
+    if sched.staging:
+        return float(sched.staging["model"]["critical_path_s"])
+    total = float(model["total_s"])
+    if sched.overlap:
+        speedup = float(sched.overlap.get("model_speedup") or 1.0)
+        if speedup > 0:
+            return total / speedup
+    return total
+
+
+def attribution(
+    plan, span_rows: Optional[List[Dict[str, Any]]] = None
+) -> Dict[str, Any]:
+    """Join measured span times against a plan's own cost model.
+
+    ``plan`` is a Schedule or a ``plan_id`` string; ``span_rows``
+    overrides the live span buffer (post-hoc analysis of an exported
+    snapshot). Returns::
+
+        {
+          "plan_id", "strategy",
+          "model":   {ici/dcn[/pcie] bytes + seconds, "wall_s"},
+          "census":  {span kind -> trace-time span count},
+          "legs":    [{"step", "tier", "calls", "measured_s",
+                       "model_s"?, "model_error"?}, ...],
+        }
+
+    ``model_error`` is signed relative error ``measured/model - 1``
+    (+0.30 = 30% slower than modeled). Legs without a priced model
+    (compute windows, dispatch phases) report measured time only —
+    attribution never invents a bound it cannot defend.
+    """
+    sched = _lookup(plan) if isinstance(plan, str) else plan
+    from ..redistribution import planner as _planner
+
+    model = dict(_planner.tier_time_model(sched))
+    model["wall_s"] = round(_modeled_wall_s(sched, model), 9)
+    if sched.staging:
+        model["staging"] = dict(sched.staging["model"])
+
+    rows = _tracing.spans() if span_rows is None else list(span_rows)
+    census: Dict[str, int] = {}
+    measured: Dict[Any, Dict[str, Any]] = {}
+    fenced: List[float] = []
+    for r in rows:
+        attrs = r.get("attrs") or {}
+        if attrs.get("plan_id") != sched.plan_id:
+            continue
+        step = attrs.get("step") or r["name"]
+        tier = attrs.get("tier")
+        if attrs.get("traced"):
+            key = f"{r['name']}" + (f":{tier}" if tier else "")
+            census[key] = census.get(key, 0) + 1
+            continue
+        if r.get("dur_s") is None:
+            continue
+        ent = measured.setdefault(
+            (step, tier), {"step": step, "tier": tier, "calls": 0, "total_s": 0.0}
+        )
+        ent["calls"] += 1
+        ent["total_s"] += float(r["dur_s"])
+        if attrs.get("fenced"):
+            fenced.append(float(r["dur_s"]))
+
+    legs: List[Dict[str, Any]] = []
+    for (step, tier), ent in sorted(
+        measured.items(), key=lambda kv: (kv[0][0], kv[0][1] or "")
+    ):
+        leg = {
+            "step": step,
+            "tier": tier,
+            "calls": ent["calls"],
+            "measured_s": round(ent["total_s"], 9),
+        }
+        if step == "execute":
+            leg["measured_s"] = round(min(fenced), 9) if fenced else leg["measured_s"]
+            model_s = model["wall_s"]
+        else:
+            model_s = model.get(f"{tier}_s") if tier in _MODEL_TIERS else None
+        if model_s:
+            leg["model_s"] = round(float(model_s), 9)
+            leg["model_error"] = round(leg["measured_s"] / float(model_s) - 1.0, 4)
+        legs.append(leg)
+
+    return {
+        "plan_id": sched.plan_id,
+        "strategy": sched.strategy,
+        "model": model,
+        "census": census,
+        "legs": legs,
+    }
+
+
+def serving_breakdown(
+    span_rows: Optional[List[Dict[str, Any]]] = None
+) -> Dict[str, Any]:
+    """Where serving time went, per lifecycle phase: p50/p95/p99 and
+    totals over the dispatcher's ``serving.*`` spans (submit, queue,
+    dispatch, fence, resolve, request, batch). Measured-only — the
+    serving path has no single analytic bound to judge against; the
+    bench's ``serving_qps`` row records this as its attribution
+    detail."""
+    from . import telemetry as _telemetry
+
+    rows = _tracing.spans() if span_rows is None else list(span_rows)
+    phases: Dict[str, List[float]] = {}
+    for r in rows:
+        name = r["name"]
+        if not name.startswith("serving.") or r.get("dur_s") is None:
+            continue
+        phases.setdefault(name, []).append(float(r["dur_s"]))
+    out: Dict[str, Any] = {}
+    for name in sorted(phases):
+        samples = sorted(phases[name])
+        out[name] = {
+            "calls": len(samples),
+            "total_s": round(sum(samples), 9),
+            "p50_s": round(_telemetry._percentile(samples, 0.50), 9),
+            "p95_s": round(_telemetry._percentile(samples, 0.95), 9),
+            "p99_s": round(_telemetry._percentile(samples, 0.99), 9),
+        }
+    return out

@@ -5,7 +5,15 @@ Counters say *how many*; events say *what, in order*: each hook point
 misses, ``ht.jit`` traces, user ``record()`` blocks) appends one dict
 with a monotonic sequence number and a timestamp relative to process
 start. The buffer is a fixed-size ring (oldest events drop first), so
-instrumenting a hot loop cannot grow memory without bound.
+instrumenting a hot loop cannot grow memory without bound — and every
+overwrite is COUNTED (``dropped``, surfaced in
+``telemetry.snapshot()['events']``): a wrapped ring must read as "the
+tail of a longer story" in a post-mortem, never as complete history.
+
+When span tracing is live (``observability.tracing``), each event
+carries an optional ``span`` field — the id of the innermost active
+span on the emitting thread — correlating the event stream with the
+trace timeline.
 
 Callers gate on ``telemetry.enabled()`` BEFORE building the field dict —
 ``emit`` itself does not re-check, keeping the enabled path one call
@@ -20,7 +28,9 @@ import time
 from collections import deque
 from typing import Any, Dict, List
 
-__all__ = ["capacity", "clear", "emit", "snapshot"]
+from . import tracing as _tracing
+
+__all__ = ["capacity", "clear", "dropped", "emit", "meta", "snapshot"]
 
 _CAPACITY = 4096
 _T0 = time.perf_counter()
@@ -28,28 +38,51 @@ _T0 = time.perf_counter()
 _lock = threading.Lock()
 _events: deque = deque(maxlen=_CAPACITY)
 _seq = 0
+_dropped = 0
 
 
 def emit(kind: str, **fields: Any) -> None:
     """Append one event. ``kind`` names the hook point; ``fields`` are
     host-side values (ints/floats/strs/tuples)."""
-    global _seq
+    global _seq, _dropped
+    span_id = _tracing.current_span_id() if _tracing._ENABLED else None
     with _lock:
         _seq += 1
-        _events.append(
-            {"seq": _seq, "t_s": round(time.perf_counter() - _T0, 6), "event": kind, **fields}
-        )
+        if len(_events) == _CAPACITY:
+            _dropped += 1
+        ev = {"seq": _seq, "t_s": round(time.perf_counter() - _T0, 6), "event": kind, **fields}
+        if span_id is not None:
+            ev["span"] = span_id
+        _events.append(ev)
 
 
 def snapshot() -> List[Dict[str, Any]]:
-    """Copy of the buffered events, oldest first."""
+    """Copy of the buffered events, oldest first. A wrapped ring holds
+    only the TAIL — check :func:`dropped` (or the ``events`` metadata
+    in ``telemetry.snapshot()``) before reading it as history."""
     with _lock:
         return [dict(e) for e in _events]
 
 
 def clear() -> None:
+    global _dropped
     with _lock:
         _events.clear()
+        _dropped = 0
+
+
+def dropped() -> int:
+    """Events overwritten by ring wrap since the last :func:`clear`."""
+    with _lock:
+        return _dropped
+
+
+def meta() -> Dict[str, int]:
+    """Ring health: ``{"capacity", "buffered", "dropped"}`` — what
+    ``telemetry.snapshot()`` surfaces so a post-mortem knows whether
+    the buffer is complete or a tail."""
+    with _lock:
+        return {"capacity": _CAPACITY, "buffered": len(_events), "dropped": _dropped}
 
 
 def capacity() -> int:

@@ -52,6 +52,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import json
+import re
 import threading
 import time
 import weakref
@@ -70,6 +71,7 @@ __all__ = [
     "export_jsonl",
     "inc",
     "observe",
+    "prometheus_text",
     "record",
     "report",
     "reset",
@@ -213,11 +215,13 @@ class Registry:
         return merged
 
     def timer_table(self) -> Dict[str, Dict[str, float]]:
-        """{name: {calls, total_s, best_s, mean_s, max_s, p50_s, p95_s}}.
+        """{name: {calls, total_s, best_s, mean_s, max_s, p50_s, p95_s,
+        p99_s}}.
 
         Merged across thread shards: calls/totals are exact sums,
-        min/max exact aggregates, and p50/p95 come from the union of the
-        per-shard sample reservoirs (each bounded by ``_SAMPLE_CAP``)."""
+        min/max exact aggregates, and the percentiles come from the
+        union of the per-shard sample reservoirs (each bounded by
+        ``_SAMPLE_CAP``)."""
         merged: Dict[str, dict] = {}
         for sh in self._all_shards():
             with sh.lock:
@@ -247,6 +251,7 @@ class Registry:
                 "max_s": agg["max_s"],
                 "p50_s": _percentile(samples, 0.50),
                 "p95_s": _percentile(samples, 0.95),
+                "p99_s": _percentile(samples, 0.99),
             }
         return table
 
@@ -268,9 +273,14 @@ _NESTING = threading.local()
 
 
 def enable() -> None:
-    """Turn telemetry collection on (also via ``HEAT_TPU_TELEMETRY=1``)."""
+    """Turn telemetry collection on (also via ``HEAT_TPU_TELEMETRY=1``).
+    Span tracing at its default ``HEAT_TPU_TRACE=auto`` follows this
+    switch (an explicit ``0``/``1`` pins it independently)."""
     global _ENABLED
     _ENABLED = True
+    from . import tracing as _tracing
+
+    _tracing._on_telemetry_switch(True)
 
 
 def disable() -> None:
@@ -278,6 +288,9 @@ def disable() -> None:
     ``reset()``."""
     global _ENABLED
     _ENABLED = False
+    from . import tracing as _tracing
+
+    _tracing._on_telemetry_switch(False)
 
 
 def enabled() -> bool:
@@ -333,8 +346,15 @@ def record(name: str, **fields) -> Iterator[None]:
 
 
 def snapshot() -> Dict[str, Any]:
-    """Point-in-time copy of all counters and timer statistics."""
-    return _REGISTRY.snapshot()
+    """Point-in-time copy of all counters and timer statistics, plus
+    the event ring's health metadata (``events.capacity/buffered/
+    dropped`` — a non-zero ``dropped`` means the event buffer is a
+    tail, not complete history)."""
+    from . import events as _events
+
+    snap = _REGISTRY.snapshot()
+    snap["events"] = _events.meta()
+    return snap
 
 
 def report(as_json: bool = False) -> Any:
@@ -350,6 +370,81 @@ def reset() -> None:
     from . import events as _events
 
     _events.clear()
+
+
+_PROM_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str, suffix: str = "") -> str:
+    return "heat_tpu_" + _PROM_SANITIZE.sub("_", name) + suffix
+
+
+def _prom_num(v: float) -> str:
+    # prometheus text format takes any Go-parseable float; plain repr of
+    # a python int/float qualifies
+    return repr(int(v)) if isinstance(v, bool) or v == int(v) else repr(float(v))
+
+
+def prometheus_text() -> str:
+    """Prometheus text-format exposition of the registry: every counter
+    as a ``_total`` counter, every timer as a summary (``quantile``
+    labels from the bounded reservoir plus ``_sum``/``_count``), the
+    event ring's health, and — when the serving layer is loaded — one
+    gauge set per live dispatcher (queue depth, request/batch/shed
+    tallies, latency quantiles) labeled by dispatcher name. Pure text,
+    no HTTP: mount it behind whatever exposition endpoint the
+    deployment already runs (``scripts/metrics_dump.py`` is the CLI
+    form)."""
+    snap = _REGISTRY.snapshot()
+    lines = []
+    for name, value in sorted(snap["counters"].items()):
+        m = _prom_name(name, "_total")
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_prom_num(value)}")
+    for name, st in sorted(snap["timers"].items()):
+        m = _prom_name(name, "_seconds")
+        lines.append(f"# TYPE {m} summary")
+        for q, key in (("0.5", "p50_s"), ("0.95", "p95_s"), ("0.99", "p99_s")):
+            lines.append(f'{m}{{quantile="{q}"}} {_prom_num(st[key])}')
+        lines.append(f"{m}_sum {_prom_num(st['total_s'])}")
+        lines.append(f"{m}_count {_prom_num(st['calls'])}")
+    from . import events as _events
+
+    emeta = _events.meta()
+    lines.append("# TYPE heat_tpu_events_dropped_total counter")
+    lines.append(f"heat_tpu_events_dropped_total {emeta['dropped']}")
+    lines.append("# TYPE heat_tpu_events_buffered gauge")
+    lines.append(f"heat_tpu_events_buffered {emeta['buffered']}")
+    # live dispatcher gauges — only when the serving layer is already
+    # loaded (never import jax into a light metrics process)
+    import sys
+
+    disp_mod = sys.modules.get("heat_tpu.serving.dispatcher")
+    if disp_mod is not None:
+        rows = [
+            (_PROM_SANITIZE.sub("_", d.name), d.stats())
+            for d in disp_mod.live_dispatchers()
+        ]
+        if rows:
+            # all samples of one metric grouped under its TYPE line
+            for g in (
+                "requests", "batches", "rejected", "shed", "rows",
+                "padded_rows", "queue_depth_max",
+            ):
+                lines.append(f"# TYPE heat_tpu_serving_{g} gauge")
+                for name, stats in rows:
+                    lines.append(
+                        'heat_tpu_serving_%s{dispatcher="%s"} %s'
+                        % (g, name, _prom_num(stats[g]))
+                    )
+            lines.append("# TYPE heat_tpu_serving_latency_seconds summary")
+            for name, stats in rows:
+                for q, key in (("0.5", "p50_s"), ("0.95", "p95_s"), ("0.99", "p99_s")):
+                    lines.append(
+                        'heat_tpu_serving_latency_seconds{dispatcher="%s",quantile="%s"} %s'
+                        % (name, q, _prom_num(stats[key]))
+                    )
+    return "\n".join(lines) + "\n"
 
 
 def export_jsonl(path: str) -> int:

@@ -72,6 +72,7 @@ from typing import Optional, Tuple
 
 from ..core._jax_compat import shard_map
 from ..observability import telemetry as _telemetry
+from ..observability import tracing as _tracing
 from . import planner as _planner
 from .schedule import Schedule
 from .spec import RedistSpec
@@ -128,7 +129,7 @@ def _a2a_chunks(sched: Schedule) -> Tuple[int, int]:
     return before, after
 
 
-def _run_laps(indices, issue, consume, state, pipelined: bool):
+def _run_laps(indices, issue, consume, state, pipelined: bool, span_attrs=None):
     """The depth-2 double-buffer skeleton every chunk/hop loop shares.
     ``issue(k)`` launches lap k's collective (laps are independent —
     each slices from the source), ``consume(state, result, k)`` folds
@@ -140,7 +141,16 @@ def _run_laps(indices, issue, consume, state, pipelined: bool):
     collectives, disjoint writes: bit-identical either way.
     (``kernels.cmatmul.ring_all_gather`` keeps its own loop — its hops
     are CHAINED through the travelling block, a different dependence
-    structure.)"""
+    structure.)
+
+    Under ``HEAT_TPU_TRACE`` the (issue, consume) pair is wrapped with
+    one span per lap call (``span_attrs``: step kind + tier from the
+    call site; plan_id rides the executor's ambient tracing context).
+    The wrappers decorate the CALLABLES, never the loop: the issue
+    order, the traced computation, and the compiled program bytes are
+    identical with the gate on or off."""
+    if _tracing._ENABLED:
+        issue, consume = _tracing.lap_probes(issue, consume, span_attrs)
     idx = list(indices)
     if not pipelined or len(idx) < 2:
         for k in idx:
@@ -358,7 +368,10 @@ def _chunked_all_to_all(
                 )
             return out
 
-    out = _run_laps(range(C), issue, consume, jnp.zeros(out_shape, x.dtype), pipelined)
+    out = _run_laps(
+        range(C), issue, consume, jnp.zeros(out_shape, x.dtype), pipelined,
+        {"step": "all_to_all", "tier": "ici+dcn" if topo is not None else "ici"},
+    )
     return jnp.moveaxis(out, 0, concat_axis)
 
 
@@ -469,7 +482,10 @@ def _chunked_a2a_flat(
             dec = _quant.decode_blocks(w, step, codec).astype(x.dtype)
             return lax.dynamic_update_slice_in_dim(out, dec, c * step, axis=1)
 
-    return _run_laps(range(C), issue, consume, jnp.zeros_like(x), pipelined)
+    return _run_laps(
+        range(C), issue, consume, jnp.zeros_like(x), pipelined,
+        {"step": "all_to_all", "tier": "ici+dcn" if topo is not None else "ici"},
+    )
 
 
 def _ring_exchange(
@@ -519,7 +535,9 @@ def _ring_exchange(
     out = jnp.zeros(out_shape, x.dtype)
     own = lax.dynamic_slice_in_dim(x, r * Bs, Bs, axis=split_axis)
     out = lax.dynamic_update_slice_in_dim(out, own, r * Bc, axis=concat_axis)
-    return _run_laps(range(1, p), hop, place, out, pipelined)
+    return _run_laps(
+        range(1, p), hop, place, out, pipelined, {"step": "ppermute", "tier": "ici"}
+    )
 
 
 # --------------------------------------------------------------------- #
@@ -938,58 +956,84 @@ def execute(comm, phys, spec: RedistSpec, sched: Optional[Schedule] = None):
             tb = sched.tier_bytes()
             _telemetry.inc("redist.tier.ici_bytes", tb["ici"])
             _telemetry.inc("redist.tier.dcn_bytes", tb["dcn"])
-    if strategy == "noop":
-        return phys
-    if strategy in ("slice",) or (strategy == "local" and not spec.is_reshape):
-        # no-collective placements: GSPMD's local slice IS the schedule,
-        # and with no collective there is nothing for shardlint to flag
-        return _reshard_direct(comm, phys, spec.gshape, spec.src_split, spec.dst_split)
-    if strategy == "replicate":
-        # the explicit full all-gather runs as a stamped program too, so
-        # its SL102 finding reports as info with the plan id attached
-        return _gather_reshape_program(comm, spec, budget, topo)(phys)
-    if strategy in ("all-to-all", "chunked-all-to-all", "ring"):
-        return _move_program(comm, spec, budget, pipelined, wire, topo)(phys)
-    if strategy == "hierarchical-a2a":
-        # the tiered decomposition (ISSUE 8): pivot-family when the plan
-        # carries a reshape step, plain move otherwise; packed when the
-        # plan carries pack/unpack steps — all re-derived from step
-        # KINDS so program and plan cannot disagree
-        if spec.is_reshape:
-            if any(st.kind in ("pack", "unpack") for st in sched.steps):
+    def _dispatch():
+        if strategy == "noop":
+            return phys
+        if strategy in ("slice",) or (strategy == "local" and not spec.is_reshape):
+            # no-collective placements: GSPMD's local slice IS the schedule,
+            # and with no collective there is nothing for shardlint to flag
+            return _reshard_direct(comm, phys, spec.gshape, spec.src_split, spec.dst_split)
+        if strategy == "replicate":
+            # the explicit full all-gather runs as a stamped program too, so
+            # its SL102 finding reports as info with the plan id attached
+            return _gather_reshape_program(comm, spec, budget, topo)(phys)
+        if strategy in ("all-to-all", "chunked-all-to-all", "ring"):
+            return _move_program(comm, spec, budget, pipelined, wire, topo)(phys)
+        if strategy == "hierarchical-a2a":
+            # the tiered decomposition (ISSUE 8): pivot-family when the plan
+            # carries a reshape step, plain move otherwise; packed when the
+            # plan carries pack/unpack steps — all re-derived from step
+            # KINDS so program and plan cannot disagree
+            if spec.is_reshape:
+                if any(st.kind in ("pack", "unpack") for st in sched.steps):
+                    if _telemetry._ENABLED:
+                        _telemetry.inc("redist.relayout.packed")
+                    impl_in, impl_out = _relayout_impls(
+                        spec, sched, concrete=not isinstance(phys, jax.core.Tracer)
+                    )
+                    return _packed_pivot_program(
+                        comm, spec, budget, impl_in, impl_out, pipelined, wire, topo
+                    )(phys)
                 if _telemetry._ENABLED:
-                    _telemetry.inc("redist.relayout.packed")
-                impl_in, impl_out = _relayout_impls(
-                    spec, sched, concrete=not isinstance(phys, jax.core.Tracer)
-                )
-                return _packed_pivot_program(
-                    comm, spec, budget, impl_in, impl_out, pipelined, wire, topo
-                )(phys)
+                    _telemetry.inc("redist.relayout.direct")
+                return _pivot_program(comm, spec, budget, pipelined, wire, topo)(phys)
+            return _move_program(comm, spec, budget, pipelined, wire, topo)(phys)
+        if strategy == "split0-pivot":
             if _telemetry._ENABLED:
                 _telemetry.inc("redist.relayout.direct")
             return _pivot_program(comm, spec, budget, pipelined, wire, topo)(phys)
-        return _move_program(comm, spec, budget, pipelined, wire, topo)(phys)
-    if strategy == "split0-pivot":
-        if _telemetry._ENABLED:
-            _telemetry.inc("redist.relayout.direct")
-        return _pivot_program(comm, spec, budget, pipelined, wire, topo)(phys)
-    if strategy == "packed-pivot":
-        if _telemetry._ENABLED:
-            _telemetry.inc("redist.relayout.packed")
-        impl_in, impl_out = _relayout_impls(
-            spec, sched, concrete=not isinstance(phys, jax.core.Tracer)
-        )
-        return _packed_pivot_program(
-            comm, spec, budget, impl_in, impl_out, pipelined, wire, topo
-        )(phys)
-    if strategy == "gather-reshape":
-        return _gather_reshape_program(comm, spec, budget, topo)(phys)
-    if strategy in ("local-reshape", "local"):
-        if spec.src_split == 0 and spec.dst_split == 0 and spec.mesh_size > 1:
-            # divisible split-0 <-> split-0: device blocks stay put
-            return _pivot_program(comm, spec, budget, pipelined, wire, topo)(phys)
-        return _local_reshape_program(comm, spec, budget)(phys)
-    raise ValueError(f"unknown strategy {strategy!r} (plan {sched.plan_id})")
+        if strategy == "packed-pivot":
+            if _telemetry._ENABLED:
+                _telemetry.inc("redist.relayout.packed")
+            impl_in, impl_out = _relayout_impls(
+                spec, sched, concrete=not isinstance(phys, jax.core.Tracer)
+            )
+            return _packed_pivot_program(
+                comm, spec, budget, impl_in, impl_out, pipelined, wire, topo
+            )(phys)
+        if strategy == "gather-reshape":
+            return _gather_reshape_program(comm, spec, budget, topo)(phys)
+        if strategy in ("local-reshape", "local"):
+            if spec.src_split == 0 and spec.dst_split == 0 and spec.mesh_size > 1:
+                # divisible split-0 <-> split-0: device blocks stay put
+                return _pivot_program(comm, spec, budget, pipelined, wire, topo)(phys)
+            return _local_reshape_program(comm, spec, budget)(phys)
+        raise ValueError(f"unknown strategy {strategy!r} (plan {sched.plan_id})")
+
+    if not _tracing._ENABLED:
+        return _dispatch()
+    # span tracing (ISSUE 15): one host-side `redist.execute` span per
+    # plan execution, with the plan_id as ambient context so the
+    # per-lap probes inside the (possibly now-tracing) program body
+    # inherit it. On a program-cache hit the body never re-traces, so
+    # the lap spans fire once per compile — span census == plan
+    # structure, pinned in tier-1.
+    # the MODULE, not the `attribution` function that shadows it in the
+    # observability package namespace (the core.jit gotcha)
+    from ..observability.attribution import register_plan as _register_plan
+
+    _register_plan(sched)
+    with _tracing.span(
+        "redist.execute",
+        plan_id=sched.plan_id,
+        strategy=strategy,
+        step="execute",
+        pipelined=pipelined,
+        n_steps=sched.n_steps,
+        n_collectives=sched.n_collectives,
+    ):
+        with _tracing.context(plan_id=sched.plan_id):
+            return _dispatch()
 
 
 def resplit_phys(comm, phys, gshape, src: Optional[int], dst: Optional[int]):

@@ -54,6 +54,7 @@ import numpy as np
 from ..core import gates as _gates
 from ..observability import events as _obs_events
 from ..observability import telemetry as _telemetry
+from ..observability import tracing as _tracing
 from .schedule import Schedule, Step
 from .spec import RedistSpec
 
@@ -383,6 +384,13 @@ def plan_staged_passes(
         ),
         staging=annotation,
     )
+    # staged plans live outside the planner's schedule cache — register
+    # for ht.observability.attribution(plan_id) lookup (cheap bounded
+    # dict; the module is shadowed by the function in the package
+    # namespace, so import the name off the module path)
+    from ..observability.attribution import register_plan as _register_plan
+
+    _register_plan(sched)
     if _telemetry._ENABLED:
         _telemetry.inc("redist.staging.planned_windows", n_total)
         _telemetry.inc("redist.staging.planned_bytes", pcie_total)
@@ -452,19 +460,30 @@ def stream_windows(
     windows: Sequence[Tuple[int, int]],
     consume: Callable[[int, Any, Tuple[int, int]], None],
     device_put: Optional[Callable[[np.ndarray], Any]] = None,
+    plan_id: Optional[str] = None,
 ) -> None:
     """Depth-2 double-buffered window loop: the ``jax.device_put`` of
     window ``k+1`` is ISSUED before window ``k``'s compute consumes the
     slab, so the PCIe (host->HBM) transfer of the next window rides
     under the current window's compute — the staging analog of the
     PR-6 prefetch-issue-then-consume chunk pipelines. ``consume(k,
-    slab_array, (start, stop))`` runs the per-window compute."""
+    slab_array, (start, stop))`` runs the per-window compute.
+
+    Under ``HEAT_TPU_TRACE`` each window gets a ``staging.stage_in``
+    span (real host wall around the ``device_put`` — the PCIe leg
+    attribution measures) and a ``staging.compute`` span around its
+    consume call, tagged with ``plan_id`` (the staged plan this stream
+    executes) when the caller provides it. The probes wrap the
+    callables, never the loop: issue order and numerics are identical
+    with the gate on or off."""
     import jax
 
     put = device_put or jax.device_put
     windows = list(windows)
     if not windows:
         return
+    if _tracing._ENABLED:
+        put, consume = _tracing.window_probes(put, consume, plan_id)
     live = _telemetry._ENABLED
     nxt = put(host.window(axis, *windows[0]))
     for k, win in enumerate(windows):

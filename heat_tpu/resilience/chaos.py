@@ -34,6 +34,7 @@ import random
 from typing import Dict, List, Optional
 
 from . import elastic as _elastic
+from ..observability import tracing as _tracing
 
 __all__ = ["ChaosMonkey"]
 
@@ -120,6 +121,9 @@ class ChaosMonkey:
             monkey._next_window += 1
             if monkey._poisons.pop(step, None):
                 monkey.log.append({"step": step, "kind": "poison-collective"})
+                # fire-time breadcrumb: the flight tail a post-mortem
+                # reads MUST contain the injected fault at its step
+                _tracing.flight_record("chaos.poison", "poison-collective", step)
                 poisoned = np.full_like(np.asarray(host_block), np.nan)
                 return put(poisoned)
             return put(host_block)
@@ -149,6 +153,7 @@ class ChaosMonkey:
             keep = self._rng.randrange(max(1, size // 2))
         with open(os.path.join(path, victim), "r+b") as f:
             f.truncate(int(keep))
+        _tracing.flight_record("chaos.truncate", victim, int(step))
         self.log.append(
             {"step": int(step), "kind": "truncate-ckpt", "entry": victim,
              "kept_bytes": int(keep), "was_bytes": size}

@@ -56,6 +56,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from ..core import gates as _gates
 from ..observability import telemetry as _telemetry
+from ..observability import tracing as _tracing
 
 __all__ = [
     "CKPT_DIR_ENV",
@@ -449,6 +450,9 @@ def save(state: Dict[str, Any], *, tag: str, step: int,
     max_slab = 0
     total = 0
     writer = None
+    save_sp = _tracing.start_span(
+        "ckpt.save", tag=tag, step=int(step)
+    ) if _tracing._ENABLED else None
     try:
         for name in sorted(state):
             value = state[name]
@@ -457,6 +461,15 @@ def save(state: Dict[str, Any], *, tag: str, step: int,
                     list(value) if isinstance(value, tuple) else value
                 )
                 continue
+            # one span per entry around the slab write stream, one
+            # around close() — the hasher join + trailing fsync, the
+            # durable edge the ckpt_write_2gb bench row prices
+            # detached: a mid-write failure (ENOSPC) must not strand an
+            # open span on the thread's parent stack
+            entry_sp = _tracing.start_span(
+                "ckpt.write", entry=name, detached=True,
+                parent_id=None if save_sp is None else save_sp.id,
+            ) if _tracing._ENABLED else None
             writer = _SlabWriter(os.path.join(tmp, f"{name}.bin"))
             if isinstance(value, DNDarray):
                 desc = _write_dnd(writer, value)
@@ -464,8 +477,13 @@ def save(state: Dict[str, Any], *, tag: str, step: int,
                 desc = _write_np(writer, value)
             else:
                 desc = _write_jax(writer, value)
-            sha, nbytes, slab_hi = writer.close()
+            with _tracing.span(
+                "ckpt.hash_commit", entry=name,
+                parent_id=None if entry_sp is None else entry_sp.id,
+            ):
+                sha, nbytes, slab_hi = writer.close()
             writer = None
+            _tracing.end_span(entry_sp, bytes=nbytes)
             desc.update({"sha256": sha, "nbytes": nbytes})
             entries[name] = desc
             max_slab = max(max_slab, slab_hi)
@@ -485,24 +503,31 @@ def save(state: Dict[str, Any], *, tag: str, step: int,
         # entry files do: a digest over its canonical serialization,
         # verified at every load
         meta["meta_sha256"] = _meta_digest(meta)
-        meta_path = os.path.join(tmp, "meta.json")
-        with open(meta_path, "w") as f:
-            json.dump(meta, f, indent=1, sort_keys=True)
-            f.flush()
-            os.fsync(f.fileno())
-        if os.path.isdir(final):
-            # re-saving an already-committed step is an explicit
-            # overwrite (not a crash-path concern): drop the old one
-            shutil.rmtree(final)
-        os.rename(tmp, final)  # THE commit point
-        _fsync_dir(os.path.dirname(final))
+        with _tracing.span(
+            "ckpt.commit", tag=tag, step=int(step), bytes=total,
+            parent_id=None if save_sp is None else save_sp.id,
+        ):
+            meta_path = os.path.join(tmp, "meta.json")
+            with open(meta_path, "w") as f:
+                json.dump(meta, f, indent=1, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            if os.path.isdir(final):
+                # re-saving an already-committed step is an explicit
+                # overwrite (not a crash-path concern): drop the old one
+                shutil.rmtree(final)
+            os.rename(tmp, final)  # THE commit point
+            _fsync_dir(os.path.dirname(final))
     except BaseException:
         if writer is not None:
             # a mid-entry failure (ENOSPC is the routine one) must not
             # leak the writer's threads/fd on every retry
             writer.abort()
         shutil.rmtree(tmp, ignore_errors=True)
+        _tracing.end_span(save_sp, status="error")
         raise
+    _tracing.end_span(save_sp, bytes=total)
+    _tracing.flight_record("ckpt.commit", tag, int(step))
     if _telemetry._ENABLED:
         _telemetry.inc("resilience.ckpt.save")
         _telemetry.inc("resilience.ckpt.bytes", total)

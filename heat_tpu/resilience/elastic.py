@@ -40,6 +40,7 @@ from typing import Callable, Dict, List, Optional
 from . import checkpoint as _ckpt
 from ..core import communication as _comm_mod
 from ..observability import telemetry as _telemetry
+from ..observability import tracing as _tracing
 
 __all__ = [
     "CollectivePoisoned",
@@ -65,7 +66,12 @@ class WorldChangedError(RuntimeError):
     act — the reason, the epoch the work was stamped with, and the old/
     new world sizes. In-flight collectives surface it instead of
     hanging; the elastic driver catches it, re-resolves, and resumes
-    from the last committed checkpoint."""
+    from the last committed checkpoint.
+
+    Carries the flight-recorder tail (ISSUE 15, ``flight_tail``): the
+    last N things the process did before the world change — the
+    post-mortem starts inside the exception object instead of a log
+    archaeology dig."""
 
     def __init__(self, reason: str, old_size: Optional[int] = None,
                  new_size: Optional[int] = None, epoch: Optional[int] = None):
@@ -73,6 +79,8 @@ class WorldChangedError(RuntimeError):
         self.old_size = old_size
         self.new_size = new_size
         self.epoch = epoch
+        _tracing.flight_record("world.changed", reason, new_size)
+        self.flight_tail = _tracing.flight_tail()
         msg = f"world changed ({reason})"
         if old_size is not None or new_size is not None:
             msg += f": {old_size} -> {new_size} devices"
@@ -167,6 +175,9 @@ class SimulatedWorldWatcher(WorldWatcher):
         if not survivors:
             raise ValueError("SimulatedWorldWatcher: a declared event left zero devices")
         self._devices = survivors
+        # fire-time breadcrumb: an injected/observed kill must be IN the
+        # flight tail the resulting WorldChangedError carries
+        _tracing.flight_record(f"chaos.{kind}", kind, int(step or 0))
         event = WorldEvent(kind, survivors, detail)
         self.events.append(event)
         return event
@@ -264,28 +275,39 @@ def invalidate_caches(reason: str = "resize") -> Dict[str, int]:
     Returns eviction counts per cache family."""
     global _EPOCH
     _EPOCH += 1
-    import importlib
+    _tracing.flight_record("world.invalidate", reason, _EPOCH)
+    _sp = _tracing.start_span(
+        "elastic.invalidate", reason=reason, epoch=_EPOCH
+    ) if _tracing._ENABLED else None
+    try:
+        import importlib
 
-    from ..redistribution import executor as _executor, planner as _planner
+        from ..redistribution import executor as _executor, planner as _planner
 
-    # heat_tpu.core.jit the MODULE is shadowed by the jit FUNCTION in
-    # the core package namespace — importlib resolves the module
-    jit_mod = importlib.import_module("heat_tpu.core.jit")
-    plans = _planner.clear_plan_cache()
-    programs = 0
-    for fn in _comm_mod._MESH_KEYED_CACHES:
-        programs += fn.cache_info().currsize
-    _comm_mod._clear_mesh_caches()
-    _executor.clear_program_cache()  # idempotent with the sweep above
-    wrappers = jit_mod.clear_wrapper_caches()
-    # order-independence with resolve_world: a communicator stamped as
-    # THE CURRENT WORLD moves forward with the bump — only dead worlds'
-    # comms stay behind and trip the fence (resolve-then-invalidate and
-    # invalidate-then-resolve both leave the installed world live)
-    cur = _comm_mod.get_comm()
-    if getattr(cur, "_ht_epoch", None) is not None:
-        cur._ht_epoch = _EPOCH
+        # heat_tpu.core.jit the MODULE is shadowed by the jit FUNCTION in
+        # the core package namespace — importlib resolves the module
+        jit_mod = importlib.import_module("heat_tpu.core.jit")
+        plans = _planner.clear_plan_cache()
+        programs = 0
+        for fn in _comm_mod._MESH_KEYED_CACHES:
+            programs += fn.cache_info().currsize
+        _comm_mod._clear_mesh_caches()
+        _executor.clear_program_cache()  # idempotent with the sweep above
+        wrappers = jit_mod.clear_wrapper_caches()
+        # order-independence with resolve_world: a communicator stamped as
+        # THE CURRENT WORLD moves forward with the bump — only dead worlds'
+        # comms stay behind and trip the fence (resolve-then-invalidate and
+        # invalidate-then-resolve both leave the installed world live)
+        cur = _comm_mod.get_comm()
+        if getattr(cur, "_ht_epoch", None) is not None:
+            cur._ht_epoch = _EPOCH
+    except BaseException:
+        # a mid-sweep failure must not strand the open span on the
+        # thread's active stack (every later span would parent to it)
+        _tracing.end_span(_sp, error=True)
+        raise
     counts = {"plans": plans, "programs": programs, "jit_entries": wrappers}
+    _tracing.end_span(_sp, **counts)
     if _telemetry._ENABLED:
         from ..observability import events as _obs_events
 
@@ -304,9 +326,11 @@ def resolve_world(devices: Optional[list] = None) -> "_comm_mod.MeshCommunicatio
     that no longer divides the shrunk world resolves flat)."""
     if devices is None:
         devices = _comm_mod.MPI_WORLD.devices
-    comm = _comm_mod.MeshCommunication(list(devices))
-    _comm_mod.use_comm(comm)
-    stamp(comm)
+    _tracing.flight_record("world.resolve", "", len(devices))
+    with _tracing.span("elastic.resolve", step="resolve", world=len(devices)):
+        comm = _comm_mod.MeshCommunication(list(devices))
+        _comm_mod.use_comm(comm)
+        stamp(comm)
     if _telemetry._ENABLED:
         _telemetry.inc("resilience.world.resolve")
     return comm
@@ -351,6 +375,9 @@ def elastic_fit(model, host, *, ckpt: "_ckpt.CheckpointConfig",
             return model.fit(host, ckpt=ckpt, _watcher=watcher, _chaos=chaos)
         except (WorldChangedError, CollectivePoisoned) as e:
             failures += 1
+            _tracing.flight_record(
+                "elastic.failover", getattr(e, "reason", "poisoned"), failures
+            )
             if _telemetry._ENABLED:
                 _telemetry.inc("resilience.fit.failover")
             if failures > max_failures:
@@ -383,14 +410,17 @@ def drain_and_rewarm(dispatcher, rebuild_endpoint: Callable[[], object],
     promised to shed — a wedged in-flight batch means this REPLICA is
     lost, and the caller must escalate, not pretend the failover
     happened."""
-    if not dispatcher.drain(reason=reason, timeout=timeout):
+    with _tracing.span("serving.drain_confirm", reason=reason):
+        confirmed = dispatcher.drain(reason=reason, timeout=timeout)
+    if not confirmed:
         raise TimeoutError(
             f"dispatcher drain ({reason}) did not confirm within "
             f"{timeout}s — the in-flight batch is wedged; escalate "
             "(replace the replica) instead of rewarming under a live worker"
         )
     t0 = time.perf_counter()
-    endpoint = rebuild_endpoint()
+    with _tracing.span("serving.rewarm", reason=reason):
+        endpoint = rebuild_endpoint()
     dispatcher.resume(endpoint=endpoint)
     if _telemetry._ENABLED:
         _telemetry.observe("resilience.serving.rewarm", time.perf_counter() - t0)

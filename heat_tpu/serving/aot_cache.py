@@ -72,6 +72,7 @@ import importlib
 _ht_jit = importlib.import_module(__name__.rsplit(".", 2)[0] + ".core.jit")
 
 from ..observability import telemetry as _telemetry
+from ..observability import tracing as _tracing
 from ..version import __version__
 
 __all__ = [
@@ -252,38 +253,49 @@ class AOTStore:
         """The stored envelope for ``key``, or ``None`` (counted as
         ``miss``, ``corrupt`` — file removed best-effort — or
         ``version_mismatch``). Never raises."""
-        path = self.path(key)
-        if not os.path.exists(path):
-            self._count("miss")
-            return None
-        t0 = time.perf_counter()
+        sp = _tracing.start_span("aot.load", key=key) if _tracing._ENABLED else None
+        outcome = "miss"
         try:
-            with open(path, "rb") as f:
-                rec = pickle.load(f)
-            if not isinstance(rec, dict) or "exported" not in rec or "meta" not in rec:
-                raise ValueError("malformed envelope")
-        except Exception:
-            self._count("corrupt")
+            path = self.path(key)
+            if not os.path.exists(path):
+                self._count("miss")
+                return None
+            t0 = time.perf_counter()
             try:
-                os.remove(path)
-            except OSError:
-                pass
-            return None
-        stamps = _envelope_stamps()
-        if {k: rec["meta"].get(k) for k in stamps} != stamps:
-            # written by another jax/heat_tpu version, platform, world
-            # size, or program-affecting gate roster: recompile (and
-            # overwrite) rather than trust it
-            self._count("version_mismatch")
-            return None
-        self._count("hit")
-        if _telemetry._ENABLED:
-            _telemetry.observe("serving.aot.load", time.perf_counter() - t0)
-        return rec
+                with open(path, "rb") as f:
+                    rec = pickle.load(f)
+                if not isinstance(rec, dict) or "exported" not in rec or "meta" not in rec:
+                    raise ValueError("malformed envelope")
+            except Exception:
+                outcome = "corrupt"
+                self._count("corrupt")
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                return None
+            stamps = _envelope_stamps()
+            if {k: rec["meta"].get(k) for k in stamps} != stamps:
+                # written by another jax/heat_tpu version, platform, world
+                # size, or program-affecting gate roster: recompile (and
+                # overwrite) rather than trust it
+                outcome = "version_mismatch"
+                self._count("version_mismatch")
+                return None
+            outcome = "hit"
+            self._count("hit")
+            if _telemetry._ENABLED:
+                _telemetry.observe("serving.aot.load", time.perf_counter() - t0)
+            return rec
+        finally:
+            _tracing.end_span(sp, outcome=outcome)
 
     def store(self, key: str, exported_bytes: bytes, out: Optional[dict],
               extra_meta: Optional[dict] = None) -> bool:
         """Atomically persist one envelope; never raises."""
+        sp = _tracing.start_span(
+            "aot.store", key=key, bytes=len(exported_bytes)
+        ) if _tracing._ENABLED else None
         try:
             os.makedirs(self.root, exist_ok=True)
             meta = _envelope_stamps()
@@ -295,9 +307,11 @@ class AOTStore:
                 pickle.dump(rec, f)
             os.replace(tmp, self.path(key))
             self._count("store")
+            _tracing.end_span(sp, outcome="store")
             return True
         except Exception:
             self._count("bypass")
+            _tracing.end_span(sp, outcome="bypass")
             return False
 
 

@@ -21,8 +21,20 @@ with the standard serving shape:
   pipelining, so an endpoint program that donates its input slab
   (buffer reuse) never races the staging of the next batch;
 - **per-request latency + queue-depth telemetry**: ``serving.request.
-  latency`` (p50/p95 via the sharded registry) and ``serving.queue.depth``
-  samples, plus always-on local tallies in :meth:`Dispatcher.stats`.
+  latency`` (p50/p95/p99 via the sharded registry) and ``serving.queue.
+  depth`` samples, plus always-on local tallies in
+  :meth:`Dispatcher.stats`.
+
+Span tracing (ISSUE 15, ``HEAT_TPU_TRACE``): the full request
+lifecycle — ``serving.submit`` (validation + enqueue), ``serving.queue``
+(enqueue → batch collection), ``serving.batch`` (a detached span
+bracketing one batch dispatch → resolve, parenting its
+``serving.dispatch`` / ``serving.fence`` / ``serving.resolve`` phase
+spans), and ``serving.request`` (submit → future resolution, per
+request). Every probe is one module-bool read when the gate is off.
+Shed and drain events additionally land in the always-on flight
+recorder, and a shed request's :class:`ServingOverloaded` carries the
+recorder tail (``exc.flight_tail``) for post-mortems.
 
 Host-sync budget (shardlint SL106/SL201): the dispatch→result hot path
 contains ZERO ``jax.device_get`` — futures resolve with device arrays
@@ -37,9 +49,10 @@ import collections
 import queue
 import threading
 import time
+import weakref
 
 from concurrent.futures import Future
-from typing import Callable, Dict, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -49,21 +62,40 @@ import jax.numpy as jnp
 from .admission import AdmissionControl, ServingOverloaded
 from . import aot_cache as _aot
 from ..observability import telemetry as _telemetry
+from ..observability import tracing as _tracing
 from ..resilience import elastic as _elastic
 
-__all__ = ["Dispatcher", "Endpoint", "estimator_endpoint", "program_endpoint"]
+__all__ = [
+    "Dispatcher", "Endpoint", "estimator_endpoint", "live_dispatchers",
+    "program_endpoint",
+]
 
 _LAT_CAP = 4096  # local latency reservoir (stats() works with telemetry off)
 
+#: every started dispatcher, weakly — what `ht.observability.
+#: prometheus_text()` walks to render per-dispatcher gauges without the
+#: serving layer handing it a handle
+_LIVE: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_dispatchers() -> List["Dispatcher"]:
+    """The currently-running dispatchers (weakly tracked from
+    :meth:`Dispatcher.start`), name-sorted — the Prometheus exposition
+    walks this."""
+    return sorted((d for d in list(_LIVE) if d.running), key=lambda d: d.name)
+
 
 class _Request:
-    __slots__ = ("payload", "rows", "future", "t_submit", "deadline")
+    __slots__ = ("payload", "rows", "future", "t_submit", "t_submit_pc", "deadline")
 
-    def __init__(self, payload, rows, future, t_submit, deadline):
+    def __init__(self, payload, rows, future, t_submit, deadline, t_submit_pc=None):
         self.payload = payload
         self.rows = rows
         self.future = future
         self.t_submit = t_submit
+        # perf_counter twin of t_submit, taken only when tracing is live
+        # (span timestamps must share tracing's clock domain)
+        self.t_submit_pc = t_submit_pc
         self.deadline = deadline
 
 
@@ -196,6 +228,7 @@ class Dispatcher:
             target=self._worker, name=f"ht-serving-{self.name}", daemon=True
         )
         self._thread.start()
+        _LIVE.add(self)
         return self
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
@@ -225,11 +258,16 @@ class Dispatcher:
                 leftovers.append(self._q.get_nowait())
             except queue.Empty:
                 break
-        for r in leftovers:
-            if not r.future.done():
-                r.future.set_exception(
-                    ServingOverloaded(reason, queue_depth=len(leftovers))
-                )
+        if leftovers:
+            # post-mortem breadcrumb + tail: a mass shed is exactly the
+            # moment the last-N-things record matters
+            _tracing.flight_record("serving.shed", reason, len(leftovers))
+            tail = _tracing.flight_tail()
+            for r in leftovers:
+                if not r.future.done():
+                    exc = ServingOverloaded(reason, queue_depth=len(leftovers))
+                    exc.flight_tail = tail
+                    r.future.set_exception(exc)
         return len(leftovers)
 
     # ------------------------------------------------------------------ #
@@ -247,6 +285,7 @@ class Dispatcher:
         self._pause_reason = reason  # racecheck: guarded-by(_pause event ordering)
         self._drained.clear()
         self._pause.set()
+        _tracing.flight_record("serving.drain", reason, self._q.qsize())
         if _telemetry._ENABLED:
             _telemetry.inc("serving.drain.count")
         if not self.running:
@@ -320,35 +359,44 @@ class Dispatcher:
                 _telemetry.inc("serving.admission.rejected")
             raise self.admission.reject_memory(peak)
         now = time.monotonic()
-        req = _Request(x, rows, Future(), now, self.admission.deadline_for(now, deadline_s))
+        sp = _tracing.start_span(
+            "serving.submit", endpoint=self.name, rows=rows
+        ) if _tracing._ENABLED else None
+        req = _Request(
+            x, rows, Future(), now, self.admission.deadline_for(now, deadline_s),
+            t_submit_pc=(time.perf_counter() if sp is not None else None),
+        )
         try:
-            self._q.put_nowait(req)
-        except queue.Full:
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                with self._counts_lock:
+                    self._counts["rejected"] += 1
+                if _telemetry._ENABLED:
+                    _telemetry.inc("serving.admission.rejected")
+                raise self.admission.reject(self._q.qsize()) from None
+            if not self.running:
+                # TOCTOU with stop(): the worker exited (and its post-stop
+                # sweep may already have run) between the running check
+                # above and the put — sweep our own enqueue so the future
+                # resolves typed instead of hanging. If the final drain
+                # already served it, the future holds a result and passes
+                # through untouched.
+                self._fail_queued("shutdown")  # submit raced stop()
+                exc = req.future.exception() if req.future.done() else None
+                if exc is not None:
+                    raise exc
+            depth = self._q.qsize()
             with self._counts_lock:
-                self._counts["rejected"] += 1
+                self._counts["requests"] += 1
+                if depth > self._depth_max:
+                    self._depth_max = depth
             if _telemetry._ENABLED:
-                _telemetry.inc("serving.admission.rejected")
-            raise self.admission.reject(self._q.qsize()) from None
-        if not self.running:
-            # TOCTOU with stop(): the worker exited (and its post-stop
-            # sweep may already have run) between the running check
-            # above and the put — sweep our own enqueue so the future
-            # resolves typed instead of hanging. If the final drain
-            # already served it, the future holds a result and passes
-            # through untouched.
-            self._fail_queued("shutdown")  # submit raced stop()
-            exc = req.future.exception() if req.future.done() else None
-            if exc is not None:
-                raise exc
-        depth = self._q.qsize()
-        with self._counts_lock:
-            self._counts["requests"] += 1
-            if depth > self._depth_max:
-                self._depth_max = depth
-        if _telemetry._ENABLED:
-            _telemetry.inc("serving.requests")
-            _telemetry.observe("serving.queue.depth", float(depth))
-        return req.future
+                _telemetry.inc("serving.requests")
+                _telemetry.observe("serving.queue.depth", float(depth))
+            return req.future
+        finally:
+            _tracing.end_span(sp)
 
     def call(self, x, timeout: Optional[float] = 60.0, deadline_s: Optional[float] = None):
         """``submit(...).result(timeout)`` convenience."""
@@ -356,16 +404,18 @@ class Dispatcher:
 
     def stats(self) -> dict:
         """Always-on local tallies (works with global telemetry off):
-        counters plus p50/p95 request latency and max observed depth."""
+        counters plus p50/p95/p99 request latency and max observed
+        depth."""
         with self._counts_lock:
             lat = sorted(self._lat)
             out = dict(self._counts)
             out["queue_depth_max"] = self._depth_max
         # the SAME nearest-rank rule the telemetry registry uses, so
-        # stats() and serving.request.latency report identical p50/p95
-        # over identical samples
+        # stats() and serving.request.latency report identical
+        # percentiles over identical samples
         out["p50_s"] = _telemetry._percentile(lat, 0.50)
         out["p95_s"] = _telemetry._percentile(lat, 0.95)
+        out["p99_s"] = _telemetry._percentile(lat, 0.99)
         return out
 
     # ------------------------------------------------------------------ #
@@ -405,9 +455,12 @@ class Dispatcher:
             if self.admission.expired(r.deadline, now):
                 with self._counts_lock:
                     self._counts["shed"] += 1
+                _tracing.flight_record("serving.shed", "deadline", self._q.qsize())
                 if _telemetry._ENABLED:
                     _telemetry.inc("serving.admission.shed")
-                r.future.set_exception(self.admission.shed(r.deadline, self._q.qsize()))
+                exc = self.admission.shed(r.deadline, self._q.qsize())
+                exc.flight_tail = _tracing.flight_tail()
+                r.future.set_exception(exc)
             else:
                 live.append(r)
         return live or None
@@ -418,15 +471,39 @@ class Dispatcher:
         after the NEXT batch has been issued (depth-2 double buffering;
         a donated input slab is therefore never re-staged while its
         program still runs)."""
+        batch_sp = None
+        if _tracing._ENABLED:
+            # detached: the batch lifecycle outlives this call frame —
+            # _resolve closes it after the fence, with another batch's
+            # dispatch span possibly opening in between
+            batch_sp = _tracing.start_span(
+                "serving.batch", detached=True, endpoint=self.name, n_reqs=len(reqs)
+            )
+            now_pc = time.perf_counter()
+            for r in reqs:
+                if r.t_submit_pc is not None:
+                    _tracing.add_span(
+                        "serving.queue", r.t_submit_pc, now_pc,
+                        parent_id=batch_sp.id, rows=r.rows,
+                    )
         batch = np.concatenate([r.payload for r in reqs], axis=0)
         rows = batch.shape[0]
         try:
-            out, bucket = self.endpoint.run(batch)
+            with _tracing.span(
+                "serving.dispatch",
+                parent_id=None if batch_sp is None else batch_sp.id,
+                endpoint=self.name, rows=rows,
+            ):
+                out, bucket = self.endpoint.run(batch)
         except Exception as e:  # program build/placement failure: fail the batch, not the loop
             for r in reqs:
                 if not r.future.done():
                     r.future.set_exception(e)
+            _tracing.end_span(batch_sp, status="error")
             return None
+        if batch_sp is not None:
+            batch_sp.attrs["bucket"] = bucket
+            batch_sp.attrs["rows"] = rows
         with self._counts_lock:
             self._counts["batches"] += 1
             self._counts["rows"] += rows
@@ -436,22 +513,29 @@ class Dispatcher:
             _telemetry.inc("serving.batch.rows", rows)
             _telemetry.inc("serving.batch.padded_rows", bucket - rows)
             _telemetry.observe("serving.queue.depth", float(self._q.qsize()))
-        return (out, reqs)
+        return (out, reqs, batch_sp)
 
     def _resolve(self, inflight) -> None:
         """Fence the batch (completion, not transfer — no device_get) and
         resolve each request's future with its lazy device-array slice.
         A poisoned batch (execution error surfacing at the fence) fails
         its own requests, never the worker loop."""
-        out, reqs = inflight
+        out, reqs, batch_sp = inflight
+        parent = None if batch_sp is None else batch_sp.id
         try:
-            jax.block_until_ready(out)
+            with _tracing.span("serving.fence", parent_id=parent, endpoint=self.name):
+                jax.block_until_ready(out)
         except Exception as e:
             for r in reqs:
                 if not r.future.done():
                     r.future.set_exception(e)
+            _tracing.end_span(batch_sp, status="error")
             return
         t_done = time.monotonic()
+        t_done_pc = time.perf_counter() if _tracing._ENABLED else 0.0
+        resolve_sp = _tracing.start_span(
+            "serving.resolve", parent_id=parent, endpoint=self.name
+        ) if _tracing._ENABLED else None
         off = 0
         for r in reqs:
             lo, hi = off, off + r.rows
@@ -465,10 +549,17 @@ class Dispatcher:
                     r.future.set_exception(e)
                 continue
             lat = t_done - r.t_submit
+            if r.t_submit_pc is not None:
+                _tracing.add_span(
+                    "serving.request", r.t_submit_pc, t_done_pc,
+                    parent_id=parent, endpoint=self.name, rows=r.rows,
+                )
             with self._counts_lock:
                 self._lat.append(lat)
             if _telemetry._ENABLED:
                 _telemetry.observe("serving.request.latency", lat)
+        _tracing.end_span(resolve_sp)
+        _tracing.end_span(batch_sp)
 
     def _worker(self) -> None:
         inflight = None

@@ -2,7 +2,7 @@
 """Chaos CI drill (ISSUE 13): kill a simulated slice mid-``fit`` and
 prove recovery end to end.
 
-One seeded run, four asserted facts:
+One seeded run, five asserted facts:
 
 1. **Detection** — the declared slice loss fires as a typed
    ``WorldChangedError`` mid-stream (never a hang), and the world
@@ -19,6 +19,11 @@ One seeded run, four asserted facts:
    also survives a chaos-truncated newest envelope by falling back to
    the committed predecessor) produces centers bit-identical to an
    uninterrupted same-seed run on the ORIGINAL world.
+5. **Flight-recorder post-mortem** (ISSUE 15) — the always-on flight
+   ring recorded the injected kill at its declared step, the
+   ``WorldChangedError`` carries that tail (``e.flight_tail``), and the
+   chaos truncation landed in the ring too — the post-mortem is inside
+   the exception, not in scrollback.
 
 Run under both CI meshes::
 
@@ -110,6 +115,18 @@ def main() -> int:
             raise AssertionError("declared slice kill never fired")
         except elastic.WorldChangedError as e:
             report["detected"] = str(e)
+            # ISSUE 15: the error is its own post-mortem — the flight
+            # tail it carries must contain the injected kill at its
+            # declared step
+            tail = getattr(e, "flight_tail", None)
+            assert tail, "WorldChangedError carries no flight-recorder tail"
+            kills = [r for r in tail
+                     if r["kind"] == "chaos.slice-lost" and r["value"] == KILL_STEP]
+            assert kills, (
+                f"flight tail is missing the injected kill at step {KILL_STEP}: "
+                f"{[(r['kind'], r['value']) for r in tail]}"
+            )
+            report["flight_tail_kill"] = kills[-1]
 
         # serving side: fence + shed typed, reject during drain. The
         # drain is ARMED while the worker is still inside the blocked
@@ -169,6 +186,15 @@ def main() -> int:
         report["bit_identical"] = True
         truncated = [e for e in monkey.log if e["kind"] == "truncate-ckpt"]
         assert truncated, "the declared checkpoint truncation never fired"
+        # the truncation must be in the flight ring too (fire-time
+        # breadcrumb next to the kill, for post-mortems with no error)
+        from heat_tpu.observability import tracing as _tracing
+
+        flight = _tracing.flight_tail(_tracing.flight_capacity())
+        assert any(r["kind"] == "chaos.truncate" for r in flight), (
+            "flight ring is missing the chaos truncation record"
+        )
+        report["flight_records"] = sorted({r["kind"] for r in flight})
 
     print(json.dumps({"chaos_drill": "ok", **report}))
     return 0

@@ -172,6 +172,50 @@ HEAT_TPU_RESILIENCE=0 python -m pytest tests/test_resilience.py -q "$@"
 echo "HEAT_TPU_RESILIENCE=0: golden dumps byte-identical + escape-hatch pins clean"
 rm -f "$res_a" "$res_b"
 
+# tracing legs (ISSUE 15): (27) span collection FORCED on
+# (HEAT_TPU_TRACE=1) over the four instrumented layers — redistribution
+# lap probes, staging window spans, the dispatcher lifecycle, and the
+# resilience slab/drain spans — every suite must stay green with the
+# recorder live (the census==plan pins in tests/test_tracing.py run
+# anchored, the rest prove the probes never perturb behavior); (28) the
+# HEAT_TPU_TRACE=0 escape hatch: the gate is registered
+# affects_programs=False, so the golden plan dumps must be
+# byte-identical with tracing hard-off vs forced on — the diff IS the
+# proof that observation never changes what runs; (29) the
+# metrics_dump/export_trace smoke: one workload process emits
+# parseable Prometheus text, a telemetry JSON snapshot, and a
+# Chrome-trace JSON doc that round-trips
+HEAT_TPU_TRACE=1 python -m pytest tests/test_tracing.py tests/test_redistribution.py tests/test_staging.py tests/test_serving.py tests/test_resilience.py -q "$@"
+
+trace_a="$(mktemp)"; trace_b="$(mktemp)"
+HEAT_TPU_TRACE=0 python scripts/redist_plans.py > "$trace_a"
+HEAT_TPU_TRACE=1 python scripts/redist_plans.py > "$trace_b"
+diff "$trace_a" "$trace_b"
+HEAT_TPU_TRACE=0 python -m pytest tests/test_tracing.py -q "$@"
+echo "HEAT_TPU_TRACE=0: golden dumps byte-identical to =1 + zero-overhead pins clean"
+rm -f "$trace_a" "$trace_b"
+
+trace_json="$(mktemp)"
+HEAT_TPU_TRACE=1 python scripts/metrics_dump.py --trace "$trace_json" | python -c "
+import sys
+lines = sys.stdin.read().splitlines()
+assert any(l.startswith('# TYPE heat_tpu_') for l in lines), 'no TYPE comments'
+vals = [l for l in lines if l and not l.startswith('#')]
+assert vals, 'no samples rendered'
+for l in vals:
+    float(l.rpartition(' ')[2])
+print(f'prometheus text: {len(vals)} samples OK')
+"
+HEAT_TPU_TRACE=1 python scripts/metrics_dump.py --json > /dev/null
+python - "$trace_json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+evs = doc["traceEvents"]
+assert evs and any(e["ph"] == "X" for e in evs), "no complete span events"
+print(f"chrome trace: {len(evs)} events OK")
+EOF
+rm -f "$trace_json"
+
 # the single CI lint entry (ISSUE 14): passes 2 + 4 + 5 — srclint
 # (SL2xx source hygiene), effectcheck (SL40x gate/cache-key staleness,
 # raw gate reads, lock discipline, pipeline protocol, swallowed worker

@@ -880,6 +880,7 @@ class TestSL406(TestCase):
             "            for r in reqs:\n"
             "                if not r.future.done():\n"
             "                    r.future.set_exception(e)\n"
+            "            _tracing.end_span(batch_sp, status=\"error\")\n"
             "            return None\n"
         )
         self.assertIn(anchor, src)

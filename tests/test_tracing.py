@@ -1,0 +1,740 @@
+"""Span tracing, flight recorder & attribution (ISSUE 15).
+
+The contract pinned here, four ways:
+
+1. **Census == plan structure** — with tracing on and the program cache
+   cleared, one chunked-a2a, one ring, and one staged execution each
+   record exactly the span census their Schedule describes (issue/
+   consume pairs == collective laps; stage_in/compute windows == the
+   staging annotation's ``n_windows``), and a dispatcher run records
+   one ``serving.batch`` span per batch it reports in ``stats()``.
+2. **Byte identity at every gate value** — ``HEAT_TPU_TRACE`` is
+   registered ``affects_programs=False``: plan canonical serializations,
+   plan_ids, the AOT gate fingerprint, and the envelope gate roster are
+   identical under ``0``/``1``/unset (the golden-dump sha pin in
+   test_effectcheck plus the ci.sh parity leg diff the full dumps).
+3. **Zero overhead at ``=0``** — the hard-off escape hatch keeps every
+   probe a single module-bool read: no span is recorded, the context
+   manager yields ``None``, and ``telemetry.enable()`` does NOT drag
+   tracing on (an explicit ``0`` beats ``auto``-follow).
+4. **Thread safety** — concurrent recorders commit every span exactly
+   once with unique ids and per-thread parentage, and the module passes
+   the racecheck/gatecheck analyzer clean (SL402–SL406).
+
+Satellites ride along: Chrome-trace export validity + structural
+determinism, the flight recorder's bound/tail/always-on contract,
+``events.dropped`` overwrite accounting + span correlation,
+``timer_table`` p99, and the Prometheus text exposition.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+import heat_tpu as ht
+
+from heat_tpu.core import gates
+from heat_tpu.observability import events, telemetry, tracing
+from heat_tpu.redistribution import RedistSpec, executor, planner, staging
+
+from test_suites.basic_test import TestCase, env_pin
+
+import importlib
+
+attribution = importlib.import_module("heat_tpu.observability.attribution")
+
+P = len(jax.devices())
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TracingCase(TestCase):
+    """Every test runs with a clean span buffer and restores the
+    ambient off state (the suite's telemetry convention)."""
+
+    def setUp(self):
+        tracing.enable()
+        tracing.clear()
+
+    def tearDown(self):
+        tracing.disable()
+        tracing.clear()
+
+
+# --------------------------------------------------------------------- #
+# 1. span primitives                                                    #
+# --------------------------------------------------------------------- #
+class TestSpanPrimitives(TracingCase):
+    def test_span_nesting_and_attrs(self):
+        with tracing.span("outer", a=1) as so:
+            with tracing.span("inner", b=2) as si:
+                self.assertEqual(si.parent, so.id)
+                self.assertEqual(tracing.current_span_id(), si.id)
+        rows = tracing.spans()
+        self.assertEqual([r["name"] for r in rows], ["inner", "outer"])
+        inner, outer = rows
+        self.assertEqual(inner["attrs"], {"b": 2})
+        self.assertEqual(outer["attrs"], {"a": 1})
+        self.assertEqual(inner["parent"], outer["id"])
+        self.assertIsNotNone(outer["dur_s"])
+        self.assertIsNone(tracing.current_span_id())
+
+    def test_ambient_context_inherited(self):
+        with tracing.context(plan_id="p1", tier="ici"):
+            with tracing.span("work", tier="dcn"):
+                pass
+        (row,) = tracing.spans()
+        # ambient attrs merge under the span's own (span wins)
+        self.assertEqual(row["attrs"], {"plan_id": "p1", "tier": "dcn"})
+
+    def test_detached_span_stays_off_the_stack(self):
+        sp = tracing.start_span("batch", detached=True)
+        self.assertIsNone(tracing.current_span_id())
+        with tracing.span("phase", parent_id=sp.id):
+            pass
+        tracing.end_span(sp, status="ok")
+        rows = {r["name"]: r for r in tracing.spans()}
+        self.assertEqual(rows["phase"]["parent"], sp.id)
+        self.assertEqual(rows["batch"]["attrs"]["status"], "ok")
+
+    def test_add_span_retroactive(self):
+        import time
+
+        t0 = time.perf_counter()
+        t1 = t0 + 0.25
+        tracing.add_span("lifecycle", t0, t1, rows=3)
+        (row,) = tracing.spans()
+        self.assertAlmostEqual(row["dur_s"], 0.25, places=6)
+        self.assertEqual(row["attrs"]["rows"], 3)
+
+    def test_ring_bound_and_dropped(self):
+        cap = tracing.capacity()
+        self.assertEqual(tracing.dropped(), 0)
+        for i in range(cap + 7):
+            tracing.add_span("s", 0.0, 1e-9, i=i)
+        self.assertEqual(len(tracing.spans()), cap)
+        self.assertEqual(tracing.dropped(), 7)
+        tracing.clear()
+        self.assertEqual(tracing.dropped(), 0)
+
+
+# --------------------------------------------------------------------- #
+# 2. census == plan structure (the acceptance pins)                     #
+# --------------------------------------------------------------------- #
+def _lap_census(sched):
+    """issue/consume span counts recorded for one traced execution of
+    ``sched``, keyed by span name (plan_id-filtered)."""
+    counts = {}
+    for r in tracing.spans():
+        attrs = r["attrs"]
+        if attrs.get("plan_id") == sched.plan_id and attrs.get("traced"):
+            counts[r["name"]] = counts.get(r["name"], 0) + 1
+    return counts
+
+
+@pytest.mark.skipif(P < 2, reason="needs a real mesh")
+class TestCensusMatchesPlan(TracingCase):
+    def _execute_traced(self, spec, budget):
+        sched = planner.plan(spec, budget)
+        oracle = np.arange(spec.size, dtype=spec.dtype).reshape(spec.gshape)
+        x = ht.array(oracle, split=spec.src_split)
+        executor.clear_program_cache()  # fresh trace: lap probes re-fire
+        tracing.clear()
+        executor.execute(self.comm, x._phys, spec, sched)
+        return sched
+
+    def test_chunked_and_ring_census(self):
+        """For every multi-lap plan the tiny-budget sweep produces, the
+        issue/consume span pairs recorded at trace time equal the plan's
+        own collective count — the census IS the plan structure. The
+        sweep covers chunked-all-to-all and (at the 8-dev mesh) the
+        ppermute ring."""
+        spec = RedistSpec.normalize((64, 48), "float32", 0, 1, P)
+        strategies = set()
+        for budget in (384, 1024, 2048):
+            sched = self._execute_traced(spec, budget)
+            strategies.add(sched.strategy)
+            laps = sum(sched.collective_counts().values())
+            census = _lap_census(sched)
+            self.assertEqual(census.get("redist.issue", 0), laps, sched.strategy)
+            self.assertEqual(census.get("redist.consume", 0), laps, sched.strategy)
+            # the execute wrapper span carries the plan id + strategy
+            execs = [
+                r for r in tracing.spans()
+                if r["name"] == "redist.execute"
+                and r["attrs"].get("plan_id") == sched.plan_id
+            ]
+            self.assertEqual(len(execs), 1)
+            self.assertEqual(execs[0]["attrs"]["strategy"], sched.strategy)
+        if P == 8:  # the sweep is 8-dev-shaped: both gated forms appear
+            self.assertIn("ring", strategies)
+            self.assertIn("chunked-all-to-all", strategies)
+
+    def test_census_cached_program_records_once(self):
+        """Lap spans fire at TRACE time: re-executing a cached program
+        adds an execute span but no new lap spans — the census counts
+        compiles, not runs."""
+        spec = RedistSpec.normalize((64, 48), "float32", 0, 1, P)
+        sched = self._execute_traced(spec, 1024)
+        first = _lap_census(sched)
+        self.assertGreater(first.get("redist.issue", 0), 0)
+        oracle = np.arange(spec.size, dtype=np.float32).reshape(spec.gshape)
+        x = ht.array(oracle, split=0)
+        executor.execute(self.comm, x._phys, spec, sched)
+        self.assertEqual(_lap_census(sched), first)
+
+    def test_staged_window_census(self):
+        """One staged stream records exactly one stage_in + one compute
+        span per window, plan_id-tagged, and the attribution join sees
+        real (non-traced) wall time on the pcie leg."""
+        data = np.arange(4096 * 64, dtype=np.float32).reshape(4096, 64)
+        host = staging.HostArray(data)
+        slab = 256 << 10
+        sched = staging.plan_staged_passes(
+            host.shape, host.dtype, [{"tag": "sketch", "axis": 0}], slab=slab
+        )
+        wins = staging.window_extents(host.shape, host.dtype.itemsize, 0, slab)
+        tracing.clear()
+        seen = []
+        staging.stream_windows(
+            host, 0, wins, lambda k, arr, w: seen.append(int(k)),
+            plan_id=sched.plan_id,
+        )
+        n = sched.staging["passes"][0]["n_windows"]
+        self.assertEqual(len(wins), n)
+        by_name = {}
+        for r in tracing.spans():
+            if r["attrs"].get("plan_id") == sched.plan_id:
+                by_name[r["name"]] = by_name.get(r["name"], 0) + 1
+        self.assertEqual(by_name.get("staging.stage_in", 0), n)
+        self.assertEqual(by_name.get("staging.compute", 0), n)
+        stage_in = [
+            r for r in tracing.spans() if r["name"] == "staging.stage_in"
+        ]
+        self.assertTrue(all(r["attrs"]["tier"] == "pcie" for r in stage_in))
+        self.assertTrue(all(not r["attrs"].get("traced") for r in stage_in))
+        self.assertTrue(all(r["attrs"]["bytes"] > 0 for r in stage_in))
+
+    def test_dispatcher_batch_census(self):
+        """serving.batch spans == the dispatcher's own batch tally, with
+        the full submit→queue→dispatch→fence→resolve lifecycle around
+        them and one serving.request span per request."""
+        from heat_tpu import serving as srv
+
+        ep = srv.Endpoint(
+            {8: jax.jit(lambda b: b * 2.0)}, (4,), np.float32, name="census"
+        )
+        with srv.Dispatcher(ep, max_queue=32, poll_s=0.001) as disp:
+            futs = [disp.submit(np.ones((2, 4), np.float32)) for _ in range(6)]
+            for f in futs:
+                f.result(timeout=60)
+            stats = disp.stats()
+        by_name = {}
+        for r in tracing.spans():
+            by_name[r["name"]] = by_name.get(r["name"], 0) + 1
+        self.assertEqual(by_name.get("serving.batch", 0), stats["batches"])
+        self.assertEqual(by_name.get("serving.submit", 0), stats["requests"])
+        self.assertEqual(by_name.get("serving.request", 0), stats["requests"])
+        self.assertEqual(by_name.get("serving.queue", 0), stats["requests"])
+        for phase in ("serving.dispatch", "serving.fence", "serving.resolve"):
+            self.assertEqual(by_name.get(phase, 0), stats["batches"], phase)
+        # phase spans parent to their batch span
+        batches = {
+            r["id"] for r in tracing.spans() if r["name"] == "serving.batch"
+        }
+        for r in tracing.spans():
+            if r["name"] in ("serving.dispatch", "serving.fence", "serving.resolve"):
+                self.assertIn(r["parent"], batches)
+
+
+# --------------------------------------------------------------------- #
+# 3. byte identity + zero overhead at =0 (the escape hatch)             #
+# --------------------------------------------------------------------- #
+class TestGateByteIdentity(TestCase):
+    def test_gate_registered_not_program_affecting(self):
+        spec = gates.GATES["HEAT_TPU_TRACE"]
+        self.assertFalse(spec.affects_programs)
+        self.assertNotIn(
+            "HEAT_TPU_TRACE", gates.program_gate_roster().split(",")
+        )
+
+    def test_plans_and_aot_stamps_identical_both_ways(self):
+        """plan canonical bytes, plan_id, the AOT gate fingerprint, and
+        the envelope gate roster must not move at any gate value (the
+        ci.sh parity leg diffs the full golden dumps on top)."""
+        from heat_tpu.serving import aot_cache
+
+        spec = RedistSpec.normalize((1000, 250000), "float32", 0, 1, 8)
+        got = {}
+        for mode in ("0", "1", None):
+            with env_pin(tracing.TRACE_ENV, mode):
+                sched = planner.plan(spec, 256 << 20, topology="flat")
+                got[mode] = (
+                    sched.plan_id,
+                    sched.canonical_json(),
+                    gates.aot_fingerprint(),
+                    aot_cache._envelope_stamps()["gate_roster"],
+                )
+        self.assertEqual(got["0"], got["1"])
+        self.assertEqual(got["0"], got[None])
+
+    def test_zero_records_nothing_and_beats_telemetry_follow(self):
+        was_tel = telemetry.enabled()
+        tracing.clear()
+        try:
+            with env_pin(tracing.TRACE_ENV, "0"):
+                tracing.disable()
+                # auto-follow must NOT engage under an explicit 0
+                telemetry.enable()
+                self.assertFalse(tracing.enabled())
+                self.assertIsNone(tracing.start_span("x"))
+                tracing.end_span(None)  # no-op by contract
+                with tracing.span("y") as sp:
+                    self.assertIsNone(sp)
+                tracing.add_span("z", 0.0, 1.0)
+                self.assertEqual(tracing.spans(), [])
+        finally:
+            telemetry.disable() if not was_tel else telemetry.enable()
+            tracing.disable()
+            tracing.clear()
+
+    def test_auto_follows_telemetry_switch(self):
+        was_tel = telemetry.enabled()
+        try:
+            with env_pin(tracing.TRACE_ENV, None):
+                tracing.disable()
+                telemetry.enable()
+                self.assertTrue(tracing.enabled())
+                telemetry.disable()
+                self.assertFalse(tracing.enabled())
+        finally:
+            telemetry.disable() if not was_tel else telemetry.enable()
+            tracing.disable()
+            tracing.clear()
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_execution_off_records_nothing(self):
+        tracing.disable()
+        tracing.clear()
+        spec = RedistSpec.normalize((64, 48), "float32", 0, 1, P)
+        sched = planner.plan(spec, 1024)
+        oracle = np.arange(64 * 48, dtype=np.float32).reshape(64, 48)
+        x = ht.array(oracle, split=0)
+        executor.clear_program_cache()
+        executor.execute(self.comm, x._phys, spec, sched)
+        self.assertEqual(tracing.spans(), [])
+
+
+# --------------------------------------------------------------------- #
+# 4. thread safety + analyzer cleanliness                               #
+# --------------------------------------------------------------------- #
+class TestThreadedRecorders(TracingCase):
+    def test_concurrent_recorders_commit_every_span_once(self):
+        N, M = 8, 200  # well under capacity: nothing may drop
+        errs = []
+
+        def worker(t):
+            try:
+                for i in range(M):
+                    with tracing.span(f"w{t}", i=i):
+                        with tracing.span(f"w{t}.inner"):
+                            pass
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(N)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        self.assertEqual(errs, [])
+        rows = tracing.spans()
+        self.assertEqual(len(rows), N * M * 2)
+        self.assertEqual(tracing.dropped(), 0)
+        ids = [r["id"] for r in rows]
+        self.assertEqual(len(ids), len(set(ids)))
+        # per-thread parentage: every inner span's parent is a span of
+        # the SAME logical worker (stacks are thread-local)
+        by_id = {r["id"]: r for r in rows}
+        for r in rows:
+            if r["name"].endswith(".inner"):
+                parent = by_id[r["parent"]]
+                self.assertEqual(parent["name"] + ".inner", r["name"])
+                self.assertEqual(parent["thread"], r["thread"])
+
+    def test_tracing_module_is_analyzer_clean(self):
+        """SL402–SL406 over the tracer and the attribution join: the
+        lock/ring/TLS discipline documented in the module must hold up
+        to the racecheck pass, not just the docstring."""
+        from heat_tpu.analysis import effectcheck
+
+        for rel in (
+            "heat_tpu/observability/tracing.py",
+            "heat_tpu/observability/attribution.py",
+        ):
+            with open(os.path.join(ROOT, rel), encoding="utf-8") as f:
+                src = f.read()
+            found = effectcheck.lint_source(src, rel)
+            self.assertEqual([repr(f) for f in found], [], rel)
+
+
+# --------------------------------------------------------------------- #
+# 5. Chrome-trace export                                                #
+# --------------------------------------------------------------------- #
+class TestExportTrace(TracingCase):
+    def _rows(self):
+        with tracing.context(plan_id="pX"):
+            with tracing.span("redist.execute", step="execute"):
+                with tracing.span("staging.stage_in", tier="pcie", window=0):
+                    pass
+        with tracing.span("serving.batch", endpoint="e"):
+            pass
+        return tracing.spans()
+
+    def test_export_valid_and_structurally_deterministic(self):
+        rows = self._rows()
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            p1, p2 = os.path.join(d, "a.json"), os.path.join(d, "b.json")
+            n1 = ht.observability.export_trace(p1, span_rows=rows)
+            n2 = ht.observability.export_trace(p2, span_rows=rows)
+            with open(p1, "rb") as f:
+                b1 = f.read()
+            with open(p2, "rb") as f:
+                b2 = f.read()
+            self.assertEqual(b1, b2)  # same rows -> byte-identical docs
+            doc = json.loads(b1)
+        self.assertEqual(n1, n2)
+        evs = doc["traceEvents"]
+        self.assertEqual(len(evs), n1)
+        phases = {e["ph"] for e in evs}
+        self.assertEqual(phases, {"M", "X", "b", "e"})
+        # every complete event is well-formed
+        for e in evs:
+            if e["ph"] == "X":
+                self.assertIn("ts", e)
+                self.assertIn("dur", e)
+                self.assertGreaterEqual(e["dur"], 0)
+                self.assertEqual(e["cat"], e["name"].split(".", 1)[0])
+                self.assertIn("span_id", e["args"])
+        # plan-correlated spans emit balanced async begin/end pairs
+        # under one id per plan
+        begins = [e for e in evs if e["ph"] == "b"]
+        ends = [e for e in evs if e["ph"] == "e"]
+        self.assertEqual(len(begins), 2)  # execute + stage_in carry pX
+        self.assertEqual(len(ends), len(begins))
+        self.assertEqual({e["id"] for e in begins}, {"pX"})
+        self.assertTrue(all(e["cat"] == "plan" for e in begins + ends))
+        # thread tracks are labeled
+        metas = [e for e in evs if e["ph"] == "M"]
+        self.assertTrue(all(e["name"] == "thread_name" for e in metas))
+        self.assertEqual(doc["otherData"]["spans"], len(rows))
+
+    def test_unfinished_spans_are_skipped(self):
+        sp = tracing.start_span("never.closed", detached=True)
+        self.assertIsNotNone(sp)
+        rows = tracing.spans() + [
+            {"id": 999, "parent": None, "name": "open", "thread": 1,
+             "t0_s": 0.0, "dur_s": None, "attrs": {}}
+        ]
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.json")
+            ht.observability.export_trace(path, span_rows=rows)
+            with open(path) as f:
+                doc = json.load(f)
+        self.assertEqual(
+            [e for e in doc["traceEvents"] if e["ph"] == "X"], []
+        )
+
+
+# --------------------------------------------------------------------- #
+# 6. flight recorder                                                    #
+# --------------------------------------------------------------------- #
+class TestFlightRecorder(TestCase):
+    def setUp(self):
+        tracing.flight_clear()
+
+    def tearDown(self):
+        tracing.flight_clear()
+
+    def test_always_on_and_bounded(self):
+        # independent of the trace gate: records land with tracing OFF
+        tracing.disable()
+        cap = tracing.flight_capacity()
+        for i in range(cap + 10):
+            tracing.flight_record("test.kind", "w", i)
+        tail = tracing.flight_tail(cap + 100)
+        self.assertEqual(len(tail), cap)
+        self.assertEqual(tail[-1]["value"], cap + 9)
+        # oldest-first, monotonic seq, fixed fields
+        seqs = [r["seq"] for r in tail]
+        self.assertEqual(seqs, sorted(seqs))
+        self.assertEqual(
+            set(tail[0]), {"seq", "t_s", "thread", "kind", "what", "value"}
+        )
+        self.assertEqual(len(tracing.flight_tail(8)), 8)
+
+    def test_world_changed_error_carries_tail(self):
+        from heat_tpu.resilience import elastic
+
+        tracing.flight_record("test.before", "breadcrumb", 42)
+        err = elastic.WorldChangedError("test-reason", old_size=8, new_size=4)
+        kinds = [r["kind"] for r in err.flight_tail]
+        self.assertIn("test.before", kinds)
+        self.assertIn("world.changed", kinds)  # the error records itself
+        self.assertEqual(err.flight_tail[-1]["what"], "test-reason")
+
+    def test_dispatcher_shed_carries_tail(self):
+        from heat_tpu import serving as srv
+        from heat_tpu.serving.admission import ServingOverloaded
+
+        from concurrent.futures import Future
+
+        ep = srv.Endpoint(
+            {8: jax.jit(lambda b: b)}, (4,), np.float32, name="shedtail"
+        )
+        disp = srv.Dispatcher(ep, max_queue=8, poll_s=0.001)
+        tracing.flight_record("test.breadcrumb", "before-shed", 7)
+        # a queued request swept by the shed path (never started: the
+        # queue is drained directly, the worker is not involved)
+        req = type("R", (), {"future": Future(), "rows": 1})()
+        disp._q.put_nowait(req)
+        shed = disp._fail_queued("failover")
+        self.assertEqual(shed, 1)
+        exc = req.future.exception()
+        self.assertIsInstance(exc, ServingOverloaded)
+        # the typed error carries the tail, breadcrumb included
+        kinds = [r["kind"] for r in exc.flight_tail]
+        self.assertIn("serving.shed", kinds)
+        self.assertIn("test.breadcrumb", kinds)
+
+
+# --------------------------------------------------------------------- #
+# 7. events: overwrite accounting + span correlation                    #
+# --------------------------------------------------------------------- #
+class TestEventsRingAccounting(TestCase):
+    def setUp(self):
+        events.clear()
+
+    def tearDown(self):
+        events.clear()
+        tracing.disable()
+        tracing.clear()
+
+    def test_overwrites_counted_and_surfaced(self):
+        cap = events.capacity()
+        self.assertEqual(events.dropped(), 0)
+        for i in range(cap + 12):
+            events.emit("test.flood", i=i)
+        self.assertEqual(events.dropped(), 12)
+        self.assertEqual(len(events.snapshot()), cap)
+        meta = events.meta()
+        self.assertEqual(meta, {"capacity": cap, "buffered": cap, "dropped": 12})
+        # the ring health rides every telemetry snapshot
+        self.assertEqual(telemetry.snapshot()["events"], meta)
+        events.clear()
+        self.assertEqual(events.dropped(), 0)
+
+    def test_events_correlate_to_active_span(self):
+        tracing.enable()
+        tracing.clear()
+        with tracing.span("correlated") as sp:
+            events.emit("test.inside")
+        events.emit("test.outside")
+        inside, outside = events.snapshot()[-2:]
+        self.assertEqual(inside["span"], sp.id)
+        self.assertNotIn("span", outside)
+
+
+# --------------------------------------------------------------------- #
+# 8. p99 + Prometheus exposition                                        #
+# --------------------------------------------------------------------- #
+class TestTelemetryExposition(TestCase):
+    def setUp(self):
+        telemetry.reset()
+        telemetry.enable()
+
+    def tearDown(self):
+        telemetry.disable()
+        telemetry.reset()
+        tracing.disable()
+        tracing.clear()
+
+    def test_timer_table_p99(self):
+        for v in range(1, 101):
+            telemetry.observe("test.lat", v / 1000.0)
+        table = telemetry.report()["timers"]["test.lat"]
+        self.assertEqual(table["calls"], 100)
+        self.assertIn("p99_s", table)
+        self.assertGreaterEqual(table["p99_s"], table["p95_s"])
+        self.assertGreaterEqual(table["p95_s"], table["p50_s"])
+        self.assertAlmostEqual(table["p99_s"], 0.099, places=3)
+
+    def test_dispatcher_stats_p99(self):
+        from heat_tpu import serving as srv
+
+        ep = srv.Endpoint(
+            {8: jax.jit(lambda b: b)}, (4,), np.float32, name="p99"
+        )
+        with srv.Dispatcher(ep, max_queue=8, poll_s=0.001) as disp:
+            disp.call(np.ones((2, 4), np.float32), timeout=60)
+            stats = disp.stats()
+        for k in ("p50_s", "p95_s", "p99_s"):
+            self.assertIn(k, stats)
+            self.assertGreater(stats[k], 0.0)
+
+    def test_prometheus_text_format(self):
+        telemetry.inc("test.prom.count", 3)
+        for v in (0.01, 0.02, 0.03):
+            telemetry.observe("test.prom.lat", v)
+        text = ht.observability.prometheus_text()
+        lines = text.splitlines()
+        self.assertIn("# TYPE heat_tpu_test_prom_count_total counter", lines)
+        self.assertIn("heat_tpu_test_prom_count_total 3", lines)
+        self.assertIn("# TYPE heat_tpu_test_prom_lat_seconds summary", lines)
+        for q in ("0.5", "0.95", "0.99"):
+            self.assertTrue(
+                any(
+                    l.startswith(f'heat_tpu_test_prom_lat_seconds{{quantile="{q}"}} ')
+                    for l in lines
+                ),
+                q,
+            )
+        self.assertTrue(any(l.startswith("heat_tpu_test_prom_lat_seconds_sum ") for l in lines))
+        self.assertIn("heat_tpu_test_prom_lat_seconds_count 3", lines)
+        self.assertIn("# TYPE heat_tpu_events_dropped_total counter", lines)
+        # exposition-format shape: every non-comment line is
+        # "name{labels} value" with a parseable float value
+        for l in lines:
+            if not l or l.startswith("#"):
+                continue
+            name_part, _, value = l.rpartition(" ")
+            self.assertTrue(name_part)
+            float(value)  # must parse
+
+    def test_prometheus_live_dispatcher_gauges(self):
+        from heat_tpu import serving as srv
+
+        ep = srv.Endpoint(
+            {8: jax.jit(lambda b: b)}, (4,), np.float32, name="promgauge"
+        )
+        with srv.Dispatcher(ep, max_queue=8, poll_s=0.001, name="promgauge") as disp:
+            disp.call(np.ones((1, 4), np.float32), timeout=60)
+            text = ht.observability.prometheus_text()
+            self.assertIn(
+                'heat_tpu_serving_requests{dispatcher="promgauge"} 1', text
+            )
+            self.assertIn(
+                'heat_tpu_serving_latency_seconds{dispatcher="promgauge",quantile="0.99"}',
+                text,
+            )
+        # stopped dispatchers drop off the exposition
+        text = ht.observability.prometheus_text()
+        self.assertNotIn('dispatcher="promgauge"', text)
+
+
+# --------------------------------------------------------------------- #
+# 9. attribution: the model-vs-measured join                            #
+# --------------------------------------------------------------------- #
+class TestAttribution(TracingCase):
+    def _synthetic_rows(self, sched, stage_s=0.002):
+        """Hand-built span rows shaped like one traced+fenced run."""
+        rows = []
+        sid = iter(range(1, 100))
+        for k in range(3):
+            rows.append({
+                "id": next(sid), "parent": None, "name": "redist.issue",
+                "thread": 1, "t0_s": 0.0, "dur_s": 0.0001,
+                "attrs": {"plan_id": sched.plan_id, "traced": True,
+                          "step": "all_to_all", "tier": "ici", "lap": k},
+            })
+        rows.append({
+            "id": next(sid), "parent": None, "name": "staging.stage_in",
+            "thread": 1, "t0_s": 0.0, "dur_s": stage_s,
+            "attrs": {"plan_id": sched.plan_id, "step": "stage_in",
+                      "tier": "pcie", "window": 0, "bytes": 1 << 20},
+        })
+        rows.append({
+            "id": next(sid), "parent": None, "name": "bench.execute",
+            "thread": 1, "t0_s": 0.0, "dur_s": 0.5,
+            "attrs": {"plan_id": sched.plan_id, "step": "execute",
+                      "fenced": True},
+        })
+        # another plan's span must not leak into the join
+        rows.append({
+            "id": next(sid), "parent": None, "name": "redist.issue",
+            "thread": 1, "t0_s": 0.0, "dur_s": 0.1,
+            "attrs": {"plan_id": "other", "traced": True},
+        })
+        return rows
+
+    def test_join_reports_census_and_model_error(self):
+        spec = RedistSpec.normalize((1000, 250000), "float32", 0, 1, 8)
+        sched = planner.plan(spec, 256 << 20, topology="flat")
+        rep = ht.observability.attribution(
+            sched, span_rows=self._synthetic_rows(sched)
+        )
+        self.assertEqual(rep["plan_id"], sched.plan_id)
+        self.assertEqual(rep["census"], {"redist.issue:ici": 3})
+        legs = {(l["step"], l["tier"]): l for l in rep["legs"]}
+        execute = legs[("execute", None)]
+        self.assertEqual(execute["measured_s"], 0.5)
+        self.assertEqual(execute["model_s"], rep["model"]["wall_s"])
+        self.assertAlmostEqual(
+            execute["model_error"],
+            round(0.5 / rep["model"]["wall_s"] - 1.0, 4), places=4,
+        )
+        stage = legs[("stage_in", "pcie")]
+        self.assertEqual(stage["calls"], 1)
+        # no pcie leg in a flat in-HBM plan's model: measured-only —
+        # attribution never invents a bound it cannot defend
+        self.assertNotIn("model_error", stage)
+        # the modeled wall reflects the overlap critical path
+        self.assertLess(rep["model"]["wall_s"], rep["model"]["total_s"])
+
+    def test_lookup_by_plan_id_and_unknown_raises(self):
+        spec = RedistSpec.normalize((64, 48), "float32", 0, 1, 8)
+        sched = planner.plan(spec, 256 << 20)
+        attribution.register_plan(sched)
+        rep = ht.observability.attribution(sched.plan_id, span_rows=[])
+        self.assertEqual(rep["plan_id"], sched.plan_id)
+        with self.assertRaises(KeyError):
+            ht.observability.attribution("no-such-plan", span_rows=[])
+
+    def test_staged_plan_uses_critical_path_model(self):
+        sched = staging.golden_staged_plans()[0][1]
+        rep = ht.observability.attribution(sched, span_rows=[])
+        self.assertEqual(
+            rep["model"]["wall_s"],
+            round(float(sched.staging["model"]["critical_path_s"]), 9),
+        )
+        self.assertIn("staging", rep["model"])
+
+    def test_serving_breakdown_percentiles(self):
+        rows = [
+            {"id": i, "parent": None, "name": "serving.request", "thread": 1,
+             "t0_s": 0.0, "dur_s": i / 1000.0, "attrs": {}}
+            for i in range(1, 21)
+        ]
+        rows.append({"id": 99, "parent": None, "name": "redist.execute",
+                     "thread": 1, "t0_s": 0.0, "dur_s": 1.0, "attrs": {}})
+        out = attribution.serving_breakdown(span_rows=rows)
+        self.assertEqual(list(out), ["serving.request"])
+        ent = out["serving.request"]
+        self.assertEqual(ent["calls"], 20)
+        self.assertAlmostEqual(ent["total_s"], sum(r / 1000 for r in range(1, 21)))
+        self.assertGreaterEqual(ent["p99_s"], ent["p95_s"])
+        self.assertGreaterEqual(ent["p95_s"], ent["p50_s"])
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
