@@ -24,6 +24,11 @@ Five pieces, one import surface:
 - :mod:`~heat_tpu.observability.hlo` — :func:`collective_counts`, the
   compile-only HLO inspector pinning each op's collective structure
   (the public form of the MULTICHIP dryrun asserts).
+- :mod:`~heat_tpu.observability.calibration` — the self-calibrating
+  cost lattice (ISSUE 16): per-edge probe suite + span ingestion,
+  persisted as stamped per-deployment lattice profiles
+  (``HEAT_TPU_LATTICE_PROFILE``), and :func:`calibration_report` — the
+  constants-vs-calibrated model-error proof the CI gate rides.
 
 Instrumentation glue for the core layers lives in
 :mod:`~heat_tpu.observability.instrument` (not re-exported).
@@ -35,6 +40,9 @@ from . import instrument
 from . import telemetry
 from . import tracing
 from . import attribution
+from . import calibration
+
+from .calibration import calibration_report
 
 from .hlo import COLLECTIVE_OPS, CollectiveReport, collective_counts
 from .telemetry import (
@@ -61,6 +69,7 @@ __all__ = [
     "COLLECTIVE_OPS",
     "CollectiveReport",
     "attribution",
+    "calibration_report",
     "collective_counts",
     "disable",
     "enable",

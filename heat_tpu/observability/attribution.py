@@ -38,12 +38,26 @@ from typing import Any, Dict, List, Optional
 
 from . import tracing as _tracing
 
-__all__ = ["attribution", "register_plan", "serving_breakdown"]
+__all__ = ["attribution", "last_reports", "register_plan", "serving_breakdown"]
 
 _PLAN_CAP = 512
+_REPORT_CAP = 64
 
 _plan_lock = threading.Lock()
 _plans: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
+
+_report_lock = threading.Lock()
+_last_reports: "collections.OrderedDict[str, List[Dict[str, Any]]]" = (
+    collections.OrderedDict()
+)
+
+
+def last_reports() -> Dict[str, List[Dict[str, Any]]]:
+    """The most recent attribution legs per plan_id (bounded) — the
+    source ``telemetry.prometheus_text`` renders its per-leg
+    ``model_error`` gauges from."""
+    with _report_lock:
+        return {pid: [dict(l) for l in legs] for pid, legs in _last_reports.items()}
 
 #: measured-leg tiers the tier model prices directly
 _MODEL_TIERS = ("ici", "dcn", "pcie")
@@ -94,8 +108,62 @@ def _modeled_wall_s(sched, model: Dict[str, Any]) -> float:
     return total
 
 
+def _edge_bps(edges: Dict[str, Any], edge: str) -> Optional[float]:
+    rec = edges.get(edge)
+    if rec is None:
+        return None
+    bps = float(rec["bps"] if isinstance(rec, dict) else rec)
+    return bps if bps > 0 else None
+
+
+def _calibrated_wall_s(sched, cal_model: Dict[str, Any], edges: Dict[str, Any]) -> float:
+    """:func:`_modeled_wall_s` under measured prices. A staged plan's
+    wall is the depth-2 critical path rebuilt from the calibrated
+    pcie/hbm legs (same ``max + min/n`` arithmetic the staging
+    annotation pins); everything else follows the constants-column
+    convention on the calibrated tier sums."""
+    if sched.staging:
+        from ..core import tiers as _tiers
+
+        pcie_total = sched.tier_bytes().get("pcie", 0)
+        n = max(int(sched.staging.get("n_windows", 1)), 1)
+        pcie_bps = _edge_bps(edges, "pcie") or _tiers.bandwidth("pcie")
+        hbm_bps = _edge_bps(edges, "hbm") or _tiers.bandwidth("hbm")
+        pcie_s = pcie_total / pcie_bps
+        hbm_s = pcie_total / hbm_bps
+        return max(pcie_s, hbm_s) + min(pcie_s, hbm_s) / n
+    total = float(cal_model["total_s"])
+    if sched.overlap:
+        speedup = float(sched.overlap.get("model_speedup") or 1.0)
+        if speedup > 0:
+            return total / speedup
+    return total
+
+
+def _resolve_calibration(sched, profile):
+    """The (edges, profile_id) the CALIBRATED model column prices
+    with, resolved nearest-first: an explicit ``profile=`` envelope,
+    the plan's own recorded ``calibration`` annotation, then the
+    ambient ``HEAT_TPU_LATTICE_PROFILE`` gate; ``(None, None)`` under
+    plain constants (no calibrated column — the report stays
+    byte-compatible with PR 15)."""
+    if profile is not None:
+        return dict(profile["edges"]), profile.get("profile_id")
+    ann = getattr(sched, "calibration", None)
+    if ann:
+        return dict(ann["edges"]), ann.get("profile_id")
+    from ..core import tiers as _tiers
+
+    prof = _tiers.active_profile()
+    if prof is not None:
+        return dict(prof["edges"]), prof.get("profile_id")
+    return None, None
+
+
 def attribution(
-    plan, span_rows: Optional[List[Dict[str, Any]]] = None
+    plan,
+    span_rows: Optional[List[Dict[str, Any]]] = None,
+    profile: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Join measured span times against a plan's own cost model.
 
@@ -105,16 +173,28 @@ def attribution(
 
         {
           "plan_id", "strategy",
-          "model":   {ici/dcn[/pcie] bytes + seconds, "wall_s"},
+          "model":   {ici/dcn[/pcie] bytes + seconds, "wall_s",
+                      "calibrated"?},
           "census":  {span kind -> trace-time span count},
           "legs":    [{"step", "tier", "calls", "measured_s",
-                       "model_s"?, "model_error"?}, ...],
+                       "model_s"?, "model_error"?,
+                       "calibrated_model_s"?, "calibrated_error"?}, ...],
         }
 
     ``model_error`` is signed relative error ``measured/model - 1``
     (+0.30 = 30% slower than modeled). Legs without a priced model
     (compute windows, dispatch phases) report measured time only —
     attribution never invents a bound it cannot defend.
+
+    ISSUE 16: when a lattice profile is in reach — the explicit
+    ``profile=`` envelope, the plan's recorded ``calibration``
+    annotation, or the ambient ``HEAT_TPU_LATTICE_PROFILE`` gate —
+    every priced leg ALSO carries ``calibrated_model_s``/
+    ``calibrated_error`` (the same join at the measured prices) and
+    ``model["calibrated"]`` records that column's price set; the
+    constants column is untouched, so the before/after pair is what
+    :func:`~heat_tpu.observability.calibration.calibration_report`
+    gates on. No profile anywhere -> the PR 15 report, byte-identical.
     """
     sched = _lookup(plan) if isinstance(plan, str) else plan
     from ..redistribution import planner as _planner
@@ -123,6 +203,21 @@ def attribution(
     model["wall_s"] = round(_modeled_wall_s(sched, model), 9)
     if sched.staging:
         model["staging"] = dict(sched.staging["model"])
+    cal_edges, cal_pid = _resolve_calibration(sched, profile)
+    cal_model: Optional[Dict[str, Any]] = None
+    if cal_edges:
+        cal_model = dict(_planner.tier_time_model(sched, edges=cal_edges))
+        cal_model["wall_s"] = round(
+            _calibrated_wall_s(sched, cal_model, cal_edges), 9
+        )
+        model["calibrated"] = {
+            "profile_id": cal_pid,
+            **{
+                k: round(float(v), 9)
+                for k, v in cal_model.items()
+                if k.endswith("_s")
+            },
+        }
 
     rows = _tracing.spans() if span_rows is None else list(span_rows)
     census: Dict[str, int] = {}
@@ -161,20 +256,40 @@ def attribution(
         if step == "execute":
             leg["measured_s"] = round(min(fenced), 9) if fenced else leg["measured_s"]
             model_s = model["wall_s"]
+            cal_s = cal_model["wall_s"] if cal_model else None
         else:
             model_s = model.get(f"{tier}_s") if tier in _MODEL_TIERS else None
+            cal_s = (
+                cal_model.get(f"{tier}_s")
+                if cal_model and tier in _MODEL_TIERS
+                else None
+            )
         if model_s:
             leg["model_s"] = round(float(model_s), 9)
             leg["model_error"] = round(leg["measured_s"] / float(model_s) - 1.0, 4)
+        if cal_s:
+            leg["calibrated_model_s"] = round(float(cal_s), 9)
+            leg["calibrated_error"] = round(
+                leg["measured_s"] / float(cal_s) - 1.0, 4
+            )
         legs.append(leg)
 
-    return {
+    report = {
         "plan_id": sched.plan_id,
         "strategy": sched.strategy,
         "model": model,
         "census": census,
         "legs": legs,
     }
+    # remember the latest diagnosis per plan (bounded) so telemetry can
+    # render the per-leg model_error gauges (ISSUE 16 satellite: the
+    # exposition surface for a long-lived serving process)
+    with _report_lock:
+        _last_reports[sched.plan_id] = legs
+        _last_reports.move_to_end(sched.plan_id)
+        while len(_last_reports) > _REPORT_CAP:
+            _last_reports.popitem(last=False)
+    return report
 
 
 def serving_breakdown(

@@ -72,6 +72,7 @@ __all__ = [
     "export_trace",
     "flight_capacity",
     "flight_clear",
+    "flight_dropped",
     "flight_record",
     "flight_tail",
     "span",
@@ -166,6 +167,7 @@ _thread_names: Dict[int, str] = {}
 _flight_lock = threading.Lock()
 _flight: deque = deque(maxlen=_FLIGHT_CAP)
 _flight_seq = 0
+_flight_dropped = 0
 
 
 def enable() -> None:
@@ -436,10 +438,13 @@ def flight_record(kind: str, what: str = "", value=None) -> None:
     bounded append; never allocates beyond the record. Deliberately not
     a span and not an event: this ring survives with the process and is
     cheap enough to leave on everywhere."""
-    global _flight_seq
+    global _flight_seq, _flight_dropped
     if not _FLIGHT_ENABLED:
         return
     with _flight_lock:
+        if len(_flight) >= _FLIGHT_CAP:
+            # the bounded deque is about to overwrite its oldest record
+            _flight_dropped += 1
         _flight_seq += 1
         _flight.append(
             {
@@ -472,6 +477,16 @@ def flight_clear() -> None:
 
 def flight_capacity() -> int:
     return _FLIGHT_CAP
+
+
+def flight_dropped() -> int:
+    """How many flight records the bounded ring has overwritten since
+    process start — the ring's health gauge (``prometheus_text``
+    exports it as ``heat_tpu_flight_dropped_total``): a large number
+    on a crashed process means the tail you are reading is recent,
+    not complete."""
+    with _flight_lock:
+        return _flight_dropped
 
 
 # --------------------------------------------------------------------- #

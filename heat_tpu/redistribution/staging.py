@@ -383,6 +383,13 @@ def plan_staged_passes(
             f"double-buffered HBM slab (HEAT_TPU_OOC)"
         ),
         staging=annotation,
+        # ISSUE 16: the model above was priced through the (possibly
+        # profile-calibrated) tiers.transfer_time — record the prices +
+        # profile_id so the verifier recomputes from the plan's OWN
+        # numbers and a recalibration re-keys the staged plan_ids too.
+        # None under the constants: bytes identical to the pre-
+        # calibration golden dumps.
+        calibration=_tiers.profile_annotation(),
     )
     # staged plans live outside the planner's schedule cache — register
     # for ht.observability.attribution(plan_id) lookup (cheap bounded

@@ -133,6 +133,12 @@ LOWER_IS_BETTER = {
     # control plane slowed down
     "recovery_s",
     "resume_s",
+    # ISSUE 16: mean |model_error| over an attribution-carrying row's
+    # priced legs — growth means the cost model's fidelity regressed;
+    # the calibrated column's mean must land at or below the constants
+    # figure (the ci.sh calibration leg's shrinkage gate)
+    "mean_abs_model_error",
+    "mean_abs_calibrated_error",
 }
 
 

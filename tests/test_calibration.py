@@ -1,0 +1,540 @@
+"""Self-calibrating cost lattice (ISSUE 16).
+
+The contract pinned here, five ways:
+
+1. **Envelope lifecycle** — build/save/load round-trips; a missing file
+   is a miss; a corrupt, tampered, or version-mismatched file is
+   counted, EVICTED, and priced as the constants — ``load_profile``
+   never raises and a bad profile never takes the library down.
+2. **Byte identity unset** — with ``HEAT_TPU_LATTICE_PROFILE`` unset
+   (or empty, or pointing at a profile that fails its checks), every
+   golden plan form is byte-identical to the constants era: same
+   canonical_json, same plan_id, no ``calibration`` key. A profile
+   sitting on disk but not activated changes nothing.
+3. **Visible invalidation** — two different profiles stamp two
+   different plan_ids (and both differ from the constants plan); the
+   SAME profile replans deterministically; the stamped annotation
+   carries the full resolved price map and ``verify_plan`` accepts it.
+4. **Mutation classes** — ``verify_plan`` names ``calibration`` when
+   the stamp drops its profile_id, prices an unknown edge, records a
+   non-positive price, or disagrees with the topology's dcn_penalty.
+5. **Loop closure** — probes measure this container; span/attribution
+   ingestion folds real windows into prices; ``calibration_report``
+   proves the calibrated column's mean |model_error| lands at or below
+   the constants column on spans generated at the measured bandwidth.
+
+Satellites: the ``heat_tpu_flight_dropped_total`` counter and the
+per-leg ``model_error``/``calibrated_error`` gauges in
+``prometheus_text``.
+"""
+
+import copy
+import importlib
+import json
+import os
+import tempfile
+
+import pytest
+
+import jax
+
+from heat_tpu.analysis.planverify import verify_plan
+from heat_tpu.core import tiers
+from heat_tpu.observability import calibration, telemetry, tracing
+from heat_tpu.redistribution import planner, staging
+
+from test_suites.basic_test import TestCase, env_pin
+
+attribution_mod = importlib.import_module("heat_tpu.observability.attribution")
+
+P = len(jax.devices())
+BUDGET = planner.DEFAULT_BUDGET_MB << 20
+GATE = "HEAT_TPU_LATTICE_PROFILE"
+
+#: tiny probe knobs — the suite must stay CPU-CI fast
+NB, REP = 1 << 16, 2
+
+
+def _mk_profile(tmpdir, name="p.json", edges=None, **kw):
+    prof = calibration.build_profile(
+        edges or {"dcn": {"bps": 50e9, "method": "test"}},
+        platform=kw.pop("platform", "cpu"),
+        topology=kw.pop("topology", "flat"),
+    )
+    path = os.path.join(tmpdir, name)
+    calibration.save_profile(prof, path)
+    return prof, path
+
+
+class CalibrationCase(TestCase):
+    """Every test starts under the constants and restores them: the
+    gate is unset, the one-entry profile cache dropped, the planner's
+    schedule cache cleared (plans built under a profile must not leak
+    into a constants test)."""
+
+    def setUp(self):
+        os.environ.pop(GATE, None)
+        tiers.reload_profile()
+        planner.clear_plan_cache()
+        calibration.reset_stats()
+
+    tearDown = setUp
+
+
+# --------------------------------------------------------------------- #
+# 1. envelope lifecycle                                                 #
+# --------------------------------------------------------------------- #
+class TestProfileEnvelope(CalibrationCase):
+    def test_roundtrip(self):
+        with tempfile.TemporaryDirectory() as d:
+            prof, path = _mk_profile(
+                d, edges={
+                    "dcn": {"bps": 50e9, "method": "test",
+                            "samples": [48e9, 50e9]},
+                    "pcie": {"bps": 12e9, "method": "test"},
+                },
+            )
+            got = calibration.load_profile(path)
+            self.assertEqual(got, prof)
+            self.assertEqual(calibration.stats()["hit"], 1)
+            # the stamp is over the measurement content
+            self.assertEqual(
+                prof["profile_id"],
+                calibration.profile_digest("cpu", "flat", prof["edges"]),
+            )
+
+    def test_version_stamp_outside_digest(self):
+        """Re-releasing heat_tpu must not re-key every plan: the
+        library version rides in the envelope but not the digest."""
+        with tempfile.TemporaryDirectory() as d:
+            prof, path = _mk_profile(d)
+            doc = json.load(open(path))
+            doc["heat_tpu"] = "0.0.0-other"
+            json.dump(doc, open(path, "w"))
+            got = calibration.load_profile(path)
+            self.assertIsNotNone(got)
+            self.assertEqual(got["profile_id"], prof["profile_id"])
+
+    def test_missing_is_miss(self):
+        self.assertIsNone(calibration.load_profile("/nonexistent/p.json"))
+        self.assertEqual(calibration.stats()["miss"], 1)
+
+    def _expect_evicted(self, path, outcome):
+        self.assertIsNone(calibration.load_profile(path))
+        self.assertEqual(calibration.stats()[outcome], 1, calibration.stats())
+        self.assertFalse(os.path.exists(path))
+
+    def test_corrupt_evicts(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "p.json")
+            with open(path, "w") as f:
+                f.write("{not json")
+            self._expect_evicted(path, "corrupt")
+
+    def test_unknown_edge_is_corrupt(self):
+        with tempfile.TemporaryDirectory() as d:
+            _, path = _mk_profile(d)
+            doc = json.load(open(path))
+            doc["edges"]["warp"] = {"bps": 1e9}
+            json.dump(doc, open(path, "w"))
+            self._expect_evicted(path, "corrupt")
+
+    def test_nonpositive_price_is_corrupt(self):
+        with tempfile.TemporaryDirectory() as d:
+            _, path = _mk_profile(d)
+            doc = json.load(open(path))
+            doc["edges"]["dcn"]["bps"] = 0.0
+            json.dump(doc, open(path, "w"))
+            self._expect_evicted(path, "corrupt")
+
+    def test_tampered_evicts(self):
+        with tempfile.TemporaryDirectory() as d:
+            _, path = _mk_profile(d)
+            doc = json.load(open(path))
+            doc["edges"]["dcn"]["bps"] = 999e9  # edited price, stale stamp
+            json.dump(doc, open(path, "w"))
+            self._expect_evicted(path, "tampered")
+
+    def test_version_mismatch_evicts(self):
+        with tempfile.TemporaryDirectory() as d:
+            _, path = _mk_profile(d)
+            doc = json.load(open(path))
+            doc["format"] = calibration._FORMAT + 1
+            json.dump(doc, open(path, "w"))
+            self._expect_evicted(path, "version_mismatch")
+
+    def test_build_profile_validates(self):
+        with pytest.raises(ValueError):
+            calibration.build_profile({"warp": {"bps": 1e9}})
+        with pytest.raises(ValueError):
+            calibration.build_profile({"dcn": {"bps": -1.0}})
+
+
+# --------------------------------------------------------------------- #
+# 2. byte identity under the constants                                  #
+# --------------------------------------------------------------------- #
+class TestUnsetByteIdentity(CalibrationCase):
+    def _golden_forms(self):
+        forms = {}
+        for topo in ("flat", "2x4"):
+            for q in ("0", "int8"):
+                for name, spec in planner.golden_specs():
+                    sched = planner.plan(spec, BUDGET, quant=q, topology=topo)
+                    forms[f"{name}@{topo}/q{q}"] = sched.canonical_json()
+        for name, sched in staging.golden_staged_plans():
+            forms[f"{name}@staged"] = sched.canonical_json()
+        return forms
+
+    def test_unset_empty_and_inactive_profile_are_identical(self):
+        baseline = self._golden_forms()
+        self.assertTrue(all('"calibration"' not in b for b in baseline.values()))
+        with env_pin(GATE, ""):
+            tiers.reload_profile()
+            planner.clear_plan_cache()
+            self.assertEqual(self._golden_forms(), baseline)
+        with tempfile.TemporaryDirectory() as d:
+            _mk_profile(d)  # on disk, NOT activated
+            tiers.reload_profile()
+            planner.clear_plan_cache()
+            self.assertEqual(self._golden_forms(), baseline)
+        self.assertEqual(tiers.active_profile(), None)
+        self.assertEqual(tiers.profile_annotation(), None)
+
+    def test_unset_pricing_is_the_constants(self):
+        for edge, (_, _, bps) in tiers.EDGES.items():
+            self.assertEqual(tiers.bandwidth(edge), bps)
+        self.assertEqual(tiers.penalty("dcn"), int(tiers.ICI_BPS / tiers.DCN_BPS))
+
+    def test_failed_profile_prices_as_constants(self):
+        """A tampered activated profile degrades to the constants —
+        same plan bytes as unset, file evicted, never an error."""
+        spec = dict(planner.golden_specs())["resplit_chunked_2gb_p8"]
+        s0 = planner.plan(spec, BUDGET, quant="0", topology="2x4")
+        with tempfile.TemporaryDirectory() as d:
+            _, path = _mk_profile(d)
+            doc = json.load(open(path))
+            doc["edges"]["dcn"]["bps"] = 999e9
+            json.dump(doc, open(path, "w"))
+            with env_pin(GATE, path):
+                tiers.reload_profile()
+                planner.clear_plan_cache()
+                s1 = planner.plan(spec, BUDGET, quant="0", topology="2x4")
+                self.assertEqual(s1.canonical_json(), s0.canonical_json())
+                self.assertIsNone(s1.calibration)
+                self.assertFalse(os.path.exists(path))
+
+
+# --------------------------------------------------------------------- #
+# 3. visible invalidation                                               #
+# --------------------------------------------------------------------- #
+class TestPlanInvalidation(CalibrationCase):
+    def _spec(self):
+        return dict(planner.golden_specs())["resplit_chunked_2gb_p8"]
+
+    def test_two_profiles_two_plan_ids(self):
+        spec = self._spec()
+        s0 = planner.plan(spec, BUDGET, quant="0", topology="2x4")
+        with tempfile.TemporaryDirectory() as d:
+            p1, f1 = _mk_profile(
+                d, "p1.json",
+                edges={"dcn": {"bps": 50e9, "method": "t"},
+                       "pcie": {"bps": 8e9, "method": "t"}},
+            )
+            p2, f2 = _mk_profile(
+                d, "p2.json", edges={"dcn": {"bps": 12.5e9, "method": "t"}},
+            )
+            with env_pin(GATE, f1):
+                tiers.reload_profile()
+                planner.clear_plan_cache()
+                s1 = planner.plan(spec, BUDGET, quant="0", topology="2x4")
+                self.assertEqual(s1.calibration["profile_id"], p1["profile_id"])
+                # the annotation records the FULL resolved price map
+                self.assertEqual(
+                    sorted(s1.calibration["edges"]), sorted(tiers.EDGES)
+                )
+                self.assertEqual(s1.calibration["edges"]["dcn"], 50e9)
+                self.assertEqual(s1.calibration["edges"]["hbm"], tiers.HBM_BPS)
+                # measured prices re-derive the topology penalty
+                self.assertEqual(s1.topology["dcn_penalty"], 4)
+                res = verify_plan(s1, topology="2x4")
+                self.assertTrue(res["ok"], res)
+                self.assertIn("calibration", res["checks"])
+            with env_pin(GATE, f2):
+                tiers.reload_profile()
+                planner.clear_plan_cache()
+                s2 = planner.plan(spec, BUDGET, quant="0", topology="2x4")
+                self.assertEqual(s2.calibration["profile_id"], p2["profile_id"])
+                self.assertEqual(s2.topology["dcn_penalty"], 16)
+                # recalibration is a visible invalidation: three ids
+                self.assertEqual(
+                    len({s0.plan_id, s1.plan_id, s2.plan_id}), 3
+                )
+                # the same profile replans deterministically
+                planner.clear_plan_cache()
+                s2b = planner.plan(spec, BUDGET, quant="0", topology="2x4")
+                self.assertEqual(s2b.canonical_json(), s2.canonical_json())
+        # constants restored: the replan matches the original bytes
+        tiers.reload_profile()
+        planner.clear_plan_cache()
+        s3 = planner.plan(spec, BUDGET, quant="0", topology="2x4")
+        self.assertEqual(s3.canonical_json(), s0.canonical_json())
+
+    def test_staged_plan_stamped_and_verifies(self):
+        with tempfile.TemporaryDirectory() as d:
+            prof, path = _mk_profile(
+                d, edges={"pcie": {"bps": 4e9, "method": "t"}},
+            )
+            st0 = staging.plan_staged_passes(
+                (4096, 4096), "float32", [{"tag": "sketch", "axis": 1}],
+                slab=64 << 20, hbm_bytes=16 << 30,
+            )
+            with env_pin(GATE, path):
+                tiers.reload_profile()
+                st1 = staging.plan_staged_passes(
+                    (4096, 4096), "float32", [{"tag": "sketch", "axis": 1}],
+                    slab=64 << 20, hbm_bytes=16 << 30,
+                )
+            self.assertIsNone(st0.calibration)
+            self.assertEqual(st1.calibration["profile_id"], prof["profile_id"])
+            self.assertNotEqual(st0.plan_id, st1.plan_id)
+            # the staging model was re-priced at the measured edge
+            self.assertGreater(
+                st1.staging["model"]["pcie_s"], st0.staging["model"]["pcie_s"]
+            )
+            self.assertTrue(verify_plan(st1)["ok"])
+
+    def test_serialization_roundtrip_keeps_stamp(self):
+        with tempfile.TemporaryDirectory() as d:
+            _, path = _mk_profile(d)
+            with env_pin(GATE, path):
+                tiers.reload_profile()
+                planner.clear_plan_cache()
+                s1 = planner.plan(self._spec(), BUDGET, topology="2x4")
+            d1 = json.loads(s1.canonical_json())
+            self.assertEqual(d1["calibration"], s1.calibration)
+            # verify accepts the dict form too
+            self.assertTrue(verify_plan(d1, topology="2x4")["ok"])
+
+
+# --------------------------------------------------------------------- #
+# 4. verify_plan mutation classes                                       #
+# --------------------------------------------------------------------- #
+class TestVerifyMutations(CalibrationCase):
+    def _calibrated_dict(self):
+        spec = dict(planner.golden_specs())["resplit_chunked_2gb_p8"]
+        with tempfile.TemporaryDirectory() as d:
+            _, path = _mk_profile(
+                d, edges={"dcn": {"bps": 50e9, "method": "t"}},
+            )
+            with env_pin(GATE, path):
+                tiers.reload_profile()
+                planner.clear_plan_cache()
+                sched = planner.plan(spec, BUDGET, topology="2x4")
+                return json.loads(sched.canonical_json())
+
+    def _expect_calibration_violation(self, mutate):
+        m = copy.deepcopy(self._calibrated_dict())
+        mutate(m)
+        res = verify_plan(m, raise_on_violation=False)
+        self.assertFalse(res["ok"])
+        self.assertIn(
+            "calibration", [v["invariant"] for v in res["violations"]], res
+        )
+
+    def test_dropped_profile_id(self):
+        self._expect_calibration_violation(
+            lambda m: m["calibration"].pop("profile_id")
+        )
+
+    def test_no_edge_prices(self):
+        self._expect_calibration_violation(
+            lambda m: m["calibration"].update(edges={})
+        )
+
+    def test_unknown_edge(self):
+        self._expect_calibration_violation(
+            lambda m: m["calibration"]["edges"].update(warp=1e9)
+        )
+
+    def test_nonpositive_price(self):
+        self._expect_calibration_violation(
+            lambda m: m["calibration"]["edges"].update(dcn=0.0)
+        )
+
+    def test_penalty_profile_mismatch(self):
+        # plan priced under one profile, stamped with another: the
+        # topology's dcn_penalty no longer matches the recorded ratio
+        self._expect_calibration_violation(
+            lambda m: m["calibration"]["edges"].update(dcn=1e9)
+        )
+
+
+# --------------------------------------------------------------------- #
+# 5. probes, ingestion, loop closure                                    #
+# --------------------------------------------------------------------- #
+class TestProbesAndIngestion(CalibrationCase):
+    def test_probe_suite_on_this_container(self):
+        out = calibration.run_probes(nbytes=NB, repeats=REP)
+        for edge in ("hbm", "pcie", "disk"):
+            self.assertIn(edge, out)
+            self.assertGreater(out[edge]["bps"], 0)
+            self.assertTrue(out[edge]["method"].startswith("probe:"))
+            self.assertEqual(len(out[edge]["samples"]), REP)
+        for edge in out:
+            self.assertIn(edge, tiers.EDGES)
+
+    @pytest.mark.skipif(P < 2, reason="needs a multi-device mesh")
+    def test_collective_probe_ici(self):
+        rec = calibration.probe_collective("ici", nbytes=NB, repeats=REP)
+        self.assertIsNotNone(rec)
+        self.assertGreater(rec["bps"], 0)
+        self.assertIn("all_gather", rec["method"])
+
+    def test_collective_probe_rejects_memory_edges(self):
+        with pytest.raises(ValueError):
+            calibration.probe_collective("hbm")
+
+    def test_dcn_probe_none_on_flat(self):
+        with env_pin("HEAT_TPU_TOPOLOGY", None):
+            self.assertIsNone(
+                calibration.probe_collective("dcn", nbytes=NB, repeats=REP)
+            )
+
+    def test_floor_retry_suspect(self):
+        seq = iter([(100, 1.0), (100, 0.01), (100, 1.0)])
+        rec = calibration._floor_retry(lambda: next(seq), 3)
+        self.assertEqual(rec["bps"], 100 / 0.01)
+        self.assertTrue(rec["measurement_suspect"])
+
+    def test_ingest_spans(self):
+        rows = [
+            {"name": "staging.stage_in", "dur_s": 0.5,
+             "attrs": {"tier": "pcie", "bytes": 5 << 30}},
+            {"name": "staging.stage_in", "dur_s": 1.0,
+             "attrs": {"tier": "pcie", "bytes": 5 << 30, "traced": True}},
+            {"name": "staging.compute", "dur_s": 0.5, "attrs": {}},
+        ]
+        samples = calibration.ingest_spans(rows)
+        self.assertEqual(sorted(samples), ["pcie"])
+        self.assertEqual(samples["pcie"], [(5 << 30) / 0.5])
+
+    def test_ingest_attribution(self):
+        rep = {
+            "model": {"dcn_bytes": 4 << 30},
+            "legs": [
+                {"tier": "dcn", "measured_s": 2.0},
+                {"tier": None, "measured_s": 1.0},
+            ],
+        }
+        samples = calibration.ingest_attribution([rep])
+        self.assertEqual(samples, {"dcn": [(4 << 30) / 2.0]})
+
+    def test_calibrate_end_to_end(self):
+        rows = [
+            {"name": "staging.stage_in", "dur_s": 1.0,
+             "attrs": {"tier": "dcn", "bytes": 30 << 30}},
+        ]
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "prof.json")
+            prof = calibration.calibrate(
+                path=path, nbytes=NB, repeats=REP, span_rows=rows,
+                platform="cpu", topology="flat",
+            )
+            # probed edges AND the span-only dcn edge are in the envelope
+            self.assertIn("hbm", prof["edges"])
+            self.assertEqual(prof["edges"]["dcn"]["method"], "spans")
+            got = calibration.load_profile(path)
+            self.assertEqual(got, prof)
+            self.assertIn(
+                f"lattice profile {prof['profile_id']}",
+                calibration.describe_profile(prof),
+            )
+
+    def test_calibration_report_shrinks_model_error(self):
+        """Spans generated at a measured bandwidth: the calibrated
+        column must judge them (near-)perfectly while the constants
+        column is off by the constants/measured ratio."""
+        spec = dict(planner.golden_specs())["resplit_chunked_2gb_p8"]
+        sched = planner.plan(spec, BUDGET, topology="2x4")
+        true_bps = {e: bps / 3.0 for e, (_, _, bps) in tiers.EDGES.items()}
+        prof = calibration.build_profile(
+            {e: {"bps": b, "method": "t"} for e, b in true_bps.items()},
+            platform="cpu", topology="2x4",
+        )
+        model = planner.tier_time_model(sched)
+        rows = []
+        for tier in ("ici", "dcn"):
+            nb = model.get(f"{tier}_bytes")
+            if nb:
+                rows.append({
+                    "name": f"redist.{tier}", "dur_s": nb / true_bps[tier],
+                    "attrs": {"plan_id": sched.plan_id, "tier": tier,
+                              "step": "exchange"},
+                })
+        self.assertTrue(rows)
+        rep = calibration.calibration_report(sched, span_rows=rows, profile=prof)
+        self.assertEqual(rep["profile_id"], prof["profile_id"])
+        self.assertGreater(rep["n_legs"], 0)
+        self.assertTrue(rep["improved"], rep)
+        self.assertLess(
+            rep["mean_abs_error_calibrated"], rep["mean_abs_error_constants"]
+        )
+        for leg in rep["legs"]:
+            self.assertAlmostEqual(leg["calibrated_error"], 0.0, places=3)
+
+    def test_attribution_constants_column_untouched_by_profile(self):
+        """The baseline model column must not drift when a profile is
+        passed — it is bench_compare's unchanged-field."""
+        spec = dict(planner.golden_specs())["resplit_chunked_2gb_p8"]
+        sched = planner.plan(spec, BUDGET, topology="2x4")
+        rows = [{
+            "name": "redist.exchange", "dur_s": 0.25,
+            "attrs": {"plan_id": sched.plan_id, "tier": "dcn",
+                      "step": "exchange"},
+        }]
+        base = attribution_mod.attribution(sched, span_rows=rows)
+        prof = calibration.build_profile(
+            {"dcn": {"bps": 1e9, "method": "t"}}, platform="cpu",
+            topology="flat",
+        )
+        cal = attribution_mod.attribution(sched, span_rows=rows, profile=prof)
+        self.assertNotIn("calibrated", base["model"])
+        self.assertEqual(cal["model"]["calibrated"]["profile_id"],
+                         prof["profile_id"])
+        for b, c in zip(base["legs"], cal["legs"]):
+            self.assertEqual(b.get("model_s"), c.get("model_s"))
+            self.assertEqual(b.get("model_error"), c.get("model_error"))
+        self.assertTrue(
+            any("calibrated_error" in l for l in cal["legs"])
+        )
+
+
+# --------------------------------------------------------------------- #
+# satellites: exposition                                                #
+# --------------------------------------------------------------------- #
+class TestExposition(CalibrationCase):
+    def test_flight_dropped_counter_exported(self):
+        before = tracing.flight_dropped()
+        for i in range(tracing.flight_capacity() + 5):
+            tracing.flight_record("test.fill", "x", i)
+        self.assertGreaterEqual(tracing.flight_dropped(), before + 5)
+        text = telemetry.prometheus_text()
+        self.assertIn("heat_tpu_flight_dropped_total", text)
+
+    def test_model_error_gauges(self):
+        spec = dict(planner.golden_specs())["resplit_chunked_2gb_p8"]
+        sched = planner.plan(spec, BUDGET, topology="2x4")
+        rows = [{
+            "name": "redist.exchange", "dur_s": 0.25,
+            "attrs": {"plan_id": sched.plan_id, "tier": "dcn",
+                      "step": "exchange"},
+        }]
+        prof = calibration.build_profile(
+            {"dcn": {"bps": 1e9, "method": "t"}}, platform="cpu",
+            topology="flat",
+        )
+        attribution_mod.attribution(sched, span_rows=rows, profile=prof)
+        text = telemetry.prometheus_text()
+        self.assertIn("heat_tpu_attribution_model_error", text)
+        self.assertIn(f'plan_id="{sched.plan_id}"', text)
+        self.assertIn("heat_tpu_attribution_calibrated_error", text)

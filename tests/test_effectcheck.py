@@ -157,6 +157,9 @@ class TestGateRegistry(TestCase):
         self.assertEqual(
             gates.program_gate_roster(), ",".join(sorted(affecting))
         )
+        # the lattice profile changes plan pricing AND (via the roster
+        # bump) AOT envelope identity — affecting, plan+aot scoped
+        self.assertIn("HEAT_TPU_LATTICE_PROFILE", affecting)
         # plan-scope gates are exactly the components of the planner key
         plan_scope = {s.name for s in gates.scope_gates("plan")}
         self.assertEqual(
@@ -164,7 +167,7 @@ class TestGateRegistry(TestCase):
             {
                 "HEAT_TPU_REDIST_BUDGET_MB", "HEAT_TPU_WIRE_QUANT",
                 "HEAT_TPU_TOPOLOGY", "HEAT_TPU_OOC", "HEAT_TPU_OOC_SLAB_MB",
-                "HEAT_TPU_HBM_BYTES",
+                "HEAT_TPU_HBM_BYTES", "HEAT_TPU_LATTICE_PROFILE",
             },
         )
         with self.assertRaises(ValueError):
@@ -512,9 +515,11 @@ class TestSeededBugMutations(TestCase):
         """Invariant: the resolved topology is a component of the
         planner's dict-cache key. Mutation: delete it from the tuple."""
         src = _read("heat_tpu/redistribution/planner.py")
-        anchor = 'key = (spec, b, qmode or "0", topo)'
+        anchor = 'key = (spec, b, qmode or "0", topo, cal["profile_id"] if cal else None)'
         self.assertIn(anchor, src)
-        mutated = src.replace(anchor, 'key = (spec, b, qmode or "0")')
+        mutated = src.replace(
+            anchor, 'key = (spec, b, qmode or "0", cal["profile_id"] if cal else None)'
+        )
         found = effectcheck.lint_source(mutated, "heat_tpu/redistribution/planner.py")
         hits = [f for f in found if f.rule == "SL402" and "HEAT_TPU_TOPOLOGY" in f.message]
         self.assertTrue(hits, [repr(f) for f in found])
