@@ -4,7 +4,7 @@ The north-star contract — compiled programs launch exactly the
 collectives the algorithm needs, every intermediate stays distributed,
 nothing round-trips through the host — is a *static* property of the
 traced program and the source tree. This package checks it before any
-TPU minute is spent, in five passes:
+TPU minute is spent, in six passes:
 
 - **Pass 1, IR lint** — :func:`ht.analysis.check(fn, *args) <check>`
   walks the jaxpr and compiled StableHLO of any heat_tpu program
@@ -64,6 +64,29 @@ TPU minute is spent, in five passes:
   IR rules fold into :func:`check`; the MPMD stage-graph work
   (ROADMAP) consumes this verifier per pipeline stage.
 
+- **Pass 6, numcheck** — :mod:`~heat_tpu.analysis.numcheck` (CLI:
+  ``python scripts/lint.py heat_tpu/ --pass numcheck``; ``--pass all``
+  runs passes 2+4+5+6 in one process) mechanizes the WRONG-NUMBER
+  class the CPU-mesh suite structurally cannot see (on CPU every
+  matmul runs f32): SL601 low-precision accumulation (bf16/f16
+  ``dot_general``/``reduce_sum``/scan carries over reduction extents
+  past the ``HEAT_TPU_NUMCHECK_ACC_DIM`` threshold without an f32
+  ``preferred_element_type``), SL602 cancellation-prone
+  subtraction-of-shared-operand-products at default MXU precision (the
+  planar-complex 13% on-chip defect, mechanized — the source arm holds
+  ``core/complex_planar.py`` to :data:`numcheck.PLANAR_PRECISION_POLICY`),
+  SL603 low-precision casts feeding loop-carried accumulators (EF
+  carries, running means — the KMeans bf16-counts bug as a rule), and
+  SL604 f64 requests under the x64-disabled platform policy (standalone
+  :func:`numcheck` only — a trace-time silent degrade no jaxpr shows).
+  The dtype vocabulary is shared with SL104 through ``_dtypes.py``. The
+  dynamic half — :func:`check_tolerance` and ``verify_plan``'s
+  ``tolerance`` invariant (SL605) — recomputes every golden plan's
+  end-to-end error bound from its recorded per-step tolerances and
+  proves it equals the schedule-level ``quant.tol`` annotation; the
+  Newton–Schulz and MPMD tolerance budgets (ROADMAP) read this
+  contract.
+
 Legitimate host boundaries are declared, by name and category, in
 :mod:`~heat_tpu.analysis.boundaries` — the whitelist is code, reviewed
 like code, and tier-1 pins its exact ``core/`` population. Rule
@@ -83,7 +106,13 @@ from .effectcheck import check_donation, check_plan_protocol
 from .findings import RULES, AnalysisReport, Finding
 from .ircheck import check
 from .memcheck import hbm_budget_bytes, memcheck
-from .planverify import PlanVerificationError, check_progress, verify_plan
+from .numcheck import numcheck
+from .planverify import (
+    PlanVerificationError,
+    check_progress,
+    check_tolerance,
+    verify_plan,
+)
 from .srclint import lint_paths, lint_source
 
 __all__ = [
@@ -96,11 +125,13 @@ __all__ = [
     "check_donation",
     "check_plan_protocol",
     "check_progress",
+    "check_tolerance",
     "commcheck",
     "hbm_budget_bytes",
     "is_declared_sync",
     "lint_paths",
     "lint_source",
     "memcheck",
+    "numcheck",
     "verify_plan",
 ]

@@ -1,0 +1,89 @@
+"""ONE dtype-classification vocabulary for the analyzer passes.
+
+Pass 1's SL104 widening/narrowing arms (ircheck) and pass 6's
+SL601–SL603 precision-flow rules (numcheck) both have to answer the
+same questions about a cast or an accumulation dtype: how many REAL
+bits of precision does this dtype carry (complex64 carries f32
+precision, not f64), is this convert a widening past the program
+inputs' promotion ceiling, is it the lossy float→int8 shape the wire
+codec sanctions, is it one of the MXU's low-precision accumulation
+formats. Like ``_groups.py`` (the one replica-group parser shared by
+SL107 and SL502) and ``_donation.py`` (the one donation resolver shared
+by SL105/SL302/SL401), this module is the shared home — the IR-lint
+and the precision-lint verdicts can never disagree about what the same
+cast means.
+
+Pure functions over ``np.dtype``-coercible values (jax's ``bfloat16``
+is registered with numpy via ml_dtypes, so ``np.dtype`` handles every
+aval dtype the walks see). No jax imports.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import numpy as np
+
+__all__ = [
+    "INT8_DTYPES",
+    "LOW_PRECISION_FLOATS",
+    "effective_itemsize",
+    "is_inexact",
+    "is_low_precision",
+    "lossy_narrowing",
+    "promotion_ceiling",
+    "widens_past",
+]
+
+#: the lossy-narrowing targets of SL104's narrowing arm: an unscaled
+#: astype to one of these ahead of a collective truncates the payload
+#: (the sanctioned narrowing is the block-quantized wire codec)
+INT8_DTYPES = (np.dtype(np.int8), np.dtype(np.uint8))
+
+#: the MXU's low-precision accumulation formats — a ``dot_general`` /
+#: ``reduce_sum`` / scan carry accumulating IN one of these compounds
+#: ~1e-2 relative error per pass (rule SL601); f32 is the sanctioned
+#: accumulator (``preferred_element_type=jnp.float32`` or an upcast)
+LOW_PRECISION_FLOATS = ("bfloat16", "float16")
+
+
+def effective_itemsize(dtype) -> int:
+    """Precision per real component: complex64 carries f32 precision."""
+    dt = np.dtype(dtype)
+    return dt.itemsize // 2 if dt.kind == "c" else dt.itemsize
+
+
+def is_inexact(dtype) -> bool:
+    """Float or complex — the dtypes precision rules reason about."""
+    return np.dtype(dtype).kind in "fc"
+
+
+def is_low_precision(dtype) -> bool:
+    """Is ``dtype`` one of the MXU low-precision accumulation formats
+    (:data:`LOW_PRECISION_FLOATS`)?"""
+    return np.dtype(dtype).name in LOW_PRECISION_FLOATS
+
+
+def promotion_ceiling(in_dtypes: Iterable, default: int = 4) -> int:
+    """The widest effective itemsize core/types.py promotion of the
+    program INPUTS can yield — SL104's widening ceiling. ``default``
+    (f32) applies when no input is inexact."""
+    widths = [effective_itemsize(d) for d in in_dtypes if is_inexact(d)]
+    return max(widths, default=default)
+
+
+def widens_past(src_dtype, dst_dtype, ceiling: int) -> bool:
+    """Is ``src → dst`` an inexact widening past ``ceiling`` bytes of
+    per-component precision (SL104's widening arm)? Non-inexact casts
+    never classify."""
+    src_dt, dst_dt = np.dtype(src_dtype), np.dtype(dst_dtype)
+    if src_dt.kind not in "fc" or dst_dt.kind not in "fc":
+        return False
+    src_w, dst_w = effective_itemsize(src_dt), effective_itemsize(dst_dt)
+    return dst_w > src_w and dst_w > ceiling
+
+
+def lossy_narrowing(src_dtype, dst_dtype) -> bool:
+    """Is ``src → dst`` the lossy float→int8 narrowing shape (SL104's
+    narrowing arm: an unscaled truncation, unless wire_codec-stamped)?"""
+    return np.dtype(src_dtype).kind in "fc" and np.dtype(dst_dtype) in INT8_DTYPES

@@ -18,9 +18,11 @@ SEVERITIES = ("error", "warning", "info")
 
 # rule id -> one-line contract. SL1xx = IR lint (compiled-program rules),
 # SL2xx = source lint (repo-invariant rules), SL3xx = memory lint (the
-# memcheck abstract interpreter). docs/PERF.md carries the narrative
-# catalog; this dict is the machine-readable index the CLI and tests key
-# on.
+# memcheck abstract interpreter), SL4xx = effect lint (effectcheck),
+# SL5xx = collective-congruence lint (commcheck), SL6xx = precision lint
+# (numcheck — the wrong-number class). docs/PERF.md carries the
+# narrative catalog; this dict is the machine-readable index the CLI and
+# tests key on.
 RULES: Dict[str, str] = {
     "SL101": "implicit-reshard: a large operand crosses the mesh through an "
              "all-to-all the algorithm did not ask for (input split disagrees "
@@ -124,6 +126,38 @@ RULES: Dict[str, str] = {
              "reachable on entry — work dispatched across a world "
              "re-resolution hangs instead of failing typed "
              "(commcheck.FENCED_DISPATCH_MODULES scopes the rule)",
+    "SL601": "low-precision accumulation: a dot_general/reduce_sum/scan "
+             "carry accumulates in bf16/f16 over a contraction/reduction "
+             "extent >= the HEAT_TPU_NUMCHECK_ACC_DIM threshold (default "
+             "1024) without an f32 preferred_element_type/upcast — each "
+             "step compounds ~1e-2 relative error (warning; extent >= "
+             "65536 escalates to error)",
+    "SL602": "cancellation-prone form: a subtraction of products sharing "
+             "an operand (the Gauss 3-multiply shape) lowered at DEFAULT "
+             "MXU precision — the planar-complex 13% on-chip defect "
+             "class (error; precision=HIGHEST-stamped forms and a "
+             "`# numcheck: ignore[SL602] -- reason` pragma downgrade to "
+             "info). The source arm holds core/complex_planar.py to "
+             "numcheck.PLANAR_PRECISION_POLICY",
+    "SL603": "low-precision carry cast: a bf16/f16 cast feeds a "
+             "loop-carried accumulator — a scan/while carry slot, or a "
+             "program output down-cast while shape-matching the float32 "
+             "input it derives from (EF carries, running means: the "
+             "KMeans bf16-counts bug as a rule; error — the residual an "
+             "EF carry stores IS the low-order bits the cast drops)",
+    "SL604": "f64-under-x64-off: the checked program's source requests "
+             "float64/complex128 while the platform x64 policy "
+             "(core/devices.py) is disabled — the dtype silently "
+             "degrades to f32 at trace time, so only a source scan can "
+             "see it (warning; call ht.use_x64(True) or request f32 "
+             "explicitly)",
+    "SL605": "tolerance-budget mismatch: a redistribution plan's "
+             "composed per-step error bound (quantize/dequantize tol "
+             "across laps, exact-bit staging/relayout/overlap steps, "
+             "dcn-tier-only codec legs in hierarchical plans) does not "
+             "equal the schedule-level quant.tol annotation — the "
+             "verify_plan `tolerance` invariant as a finding "
+             "(check_tolerance; error)",
 }
 
 

@@ -74,10 +74,16 @@ def _nbytes(shape, dtype) -> int:
     return n * np.dtype(dtype).itemsize
 
 
-def _effective_itemsize(dtype) -> int:
-    """Precision per real component: complex64 carries f32 precision."""
-    dt = np.dtype(dtype)
-    return dt.itemsize // 2 if dt.kind == "c" else dt.itemsize
+# ONE dtype vocabulary (analysis/_dtypes.py, ISSUE 17) shared with
+# numcheck's SL601-SL603 precision rules — the widening/narrowing
+# classification of a cast is decided in exactly one place
+from ._dtypes import effective_itemsize as _effective_itemsize
+from ._dtypes import (
+    INT8_DTYPES as _INT8_DTYPES,
+    lossy_narrowing as _lossy_narrowing,
+    promotion_ceiling as _promotion_ceiling,
+    widens_past as _widens_past,
+)
 
 
 def _walk_jaxprs(jaxpr):
@@ -261,6 +267,16 @@ def check(
     _label = getattr(fn, "__name__", "") or ""
     findings += scan_jaxpr_divergence(closed, label=_label)
     findings += scan_hlo_congruence(text)
+
+    # ---- SL601-SL603: precision flow (pass 6 folded in) ---------------
+    # SL604 (f64 under x64-off) stays standalone-only: it is a SOURCE
+    # rule a jaxpr cannot witness, and folding it would re-flag every
+    # sanctioned widening fixture SL104 already prices
+    from .numcheck import fn_pragmas, scan_jaxpr_precision
+
+    findings += scan_jaxpr_precision(
+        closed, label=_label, pragmas=fn_pragmas(fn)
+    )
 
     # ---- SL101 / SL102: large resharding collectives -------------------
     from .boundaries import (
@@ -468,20 +484,14 @@ def check(
                 break
 
     # ---- SL104: dtype widening beyond input promotion ------------------
-    inexact_in = [
-        _effective_itemsize(d) for _, d in in_avals if np.dtype(d).kind in "fc"
-    ]
-    ceiling = max(inexact_in, default=4)
+    ceiling = _promotion_ceiling(d for _, d in in_avals)
     seen_widen = set()
     for eqn in _walk_jaxprs(closed.jaxpr):
         if eqn.primitive.name != "convert_element_type":
             continue
         src_dt = np.dtype(eqn.invars[0].aval.dtype)
         dst_dt = np.dtype(eqn.params.get("new_dtype"))
-        if src_dt.kind not in "fc" or dst_dt.kind not in "fc":
-            continue
-        src_w, dst_w = _effective_itemsize(src_dt), _effective_itemsize(dst_dt)
-        if dst_w > src_w and dst_w > ceiling and (src_dt.name, dst_dt.name) not in seen_widen:
+        if _widens_past(src_dt, dst_dt, ceiling) and (src_dt.name, dst_dt.name) not in seen_widen:
             seen_widen.add((src_dt.name, dst_dt.name))
             findings.append(
                 Finding(
@@ -517,7 +527,6 @@ def check(
         # invars (the operands), which is exactly the dataflow step
         "pjit", "custom_jvp_call", "custom_vjp_call",
     }
-    int8_dts = (np.dtype(np.int8), np.dtype(np.uint8))
     seen_narrow = set()
     # ONE producer map over every (sub-)jaxpr: vars are unique objects,
     # so the map lets the backward walk cross call boundaries — a
@@ -573,7 +582,7 @@ def check(
             if name == "convert_element_type":
                 src_dt = np.dtype(src.invars[0].aval.dtype)
                 dst_dt = np.dtype(src.params.get("new_dtype"))
-                if src_dt.kind in "fc" and dst_dt in int8_dts:
+                if _lossy_narrowing(src_dt, dst_dt):
                     stamped = wire_codec_stamped(str(src.source_info.name_stack))
                     dkey = (src_dt.name, dst_dt.name, eqn.primitive.name, stamped)
                     if dkey in seen_narrow:

@@ -1,0 +1,780 @@
+"""Pass 6: precision lint (``numcheck``) — the wrong-number class,
+mechanized.
+
+Pass 5 (commcheck) mechanized the class of programs that HANG a TPU
+mesh; this pass mechanizes the class that silently returns WRONG
+numbers. The motivating defect is real: planar-complex matmul at
+default MXU precision returned up to 13% relative error on chip (the
+Gauss 3-multiply form recovers the imaginary part by cancellation,
+which bf16 MXU passes amplify into garbage — the round-5 live defect
+PR 5 fixed by hand). The CPU-mesh suite structurally cannot see this
+class — on CPU every matmul runs f32 — so the rules are STATIC: a
+dtype-and-precision walk over the traced jaxpr, plus a source policy
+check, before any TPU minute is spent.
+
+========  ========  ====================================================
+rule      severity  fires when
+========  ========  ====================================================
+SL601     warn/err  low-precision accumulation: a ``dot_general`` /
+                    ``reduce_sum`` / scan carry accumulates in
+                    bf16/f16 over a contraction/reduction extent >=
+                    the threshold (default 1024,
+                    ``HEAT_TPU_NUMCHECK_ACC_DIM`` via the gates
+                    registry) without an f32
+                    ``preferred_element_type``/upcast; extents >=
+                    65536 escalate to error
+SL602     error     cancellation-prone form: subtraction of two
+                    products sharing an operand (the Gauss 3-multiply
+                    shape) lowered at DEFAULT precision — the
+                    planar-complex 13% defect class.
+                    ``precision=HIGHEST``-stamped forms and a
+                    ``# numcheck: ignore[SL602] -- reason`` pragma
+                    downgrade to info. The source arm (``lint_paths``,
+                    the ``--pass numcheck`` CLI) enforces
+                    :data:`PLANAR_PRECISION_POLICY` over
+                    core/complex_planar.py itself: deleting the PR 5
+                    ``precision="highest"`` default is caught here
+SL603     error     low-precision cast feeding a loop-carried
+                    accumulator: a bf16/f16 convert feeds a
+                    scan/while carry slot, or a program output is
+                    down-cast to bf16/f16 while shape-matching a
+                    float32 input it derives from (the cross-step
+                    EF-carry / running-mean idiom — the KMeans
+                    bf16-counts bug PR 11 fixed by hand, as a rule)
+SL604     warning   f64 request under the x64-disabled platform
+                    policy (core/devices.py): the dtype silently
+                    degrades to f32 at trace time, so the jaxpr never
+                    shows it — a SOURCE scan of the checked program
+========  ========  ====================================================
+
+The dtype vocabulary (what counts as low-precision, widening,
+narrowing) is shared with ircheck's SL104 arms through
+``analysis/_dtypes.py`` — the two passes can never disagree on a
+cast's classification. The IR rules (SL601–SL603) fold into
+:func:`ht.analysis.check <heat_tpu.analysis.ircheck.check>`; the
+standalone entry :func:`numcheck` additionally runs the SL604 source
+scan. The plan-side dynamic half — the ``tolerance`` invariant of
+``verify_plan`` and :func:`~heat_tpu.analysis.planverify.check_tolerance`
+(rule SL605) — lives in :mod:`~heat_tpu.analysis.planverify`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from typing import Any, Dict, FrozenSet, List, Optional, Set
+
+import numpy as np
+
+from . import _dtypes
+from .findings import AnalysisReport, Finding
+
+__all__ = [
+    "PLANAR_PRECISION_POLICY",
+    "lint_paths",
+    "lint_source",
+    "numcheck",
+    "scan_jaxpr_precision",
+    "scan_precision_source",
+]
+
+#: the per-op planar-complex precision policy (VERDICT r5 leftover,
+#: docs/MIGRATING.md "Complex platform policy" / docs/PERF.md): which
+#: planar ops MUST default to ``precision="highest"`` (their Gauss
+#: decomposition recovers a component by cancellation of MXU products)
+#: vs tolerate the default (elementwise VPU f32 arithmetic — no MXU
+#: pass to lose precision on). The numcheck source arm enforces the
+#: "highest" rows over core/complex_planar.py itself.
+PLANAR_PRECISION_POLICY: Dict[str, str] = {
+    "matmul": "highest",   # Gauss 3-multiply: C_i = P3-P1-P2 by cancellation
+    "dot": "highest",      # 2-D routes through matmul (1-D is VPU elementwise)
+    "vdot": "default",     # conj-multiply + sum: VPU f32, no MXU pass
+    "vecdot": "default",   # same elementwise family
+    "outer": "default",    # broadcast multiply: VPU f32
+}
+
+#: the module the SL602 source arm holds to the policy table
+_PLANAR_MODULE = "core/complex_planar.py"
+
+#: SL601 extent at which a low-precision accumulation escalates from
+#: warning to error: 65536 bf16 accumulation steps compound ~1e-2
+#: relative error past any usable tolerance
+_SL601_ERROR_EXTENT = 65536
+
+_NUMCHECK_PRAGMA = re.compile(r"#\s*numcheck:\s*ignore\[([A-Z0-9,\s*]+)\]")
+
+#: shape-transparent primitives the backward walks step through — the
+#: same dataflow vocabulary as ircheck's narrowing walk
+_PASSTHROUGH = {
+    "concatenate", "reshape", "transpose", "squeeze", "broadcast_in_dim",
+    "slice", "dynamic_slice", "pad", "rev", "select_n", "copy",
+    "convert_element_type",
+}
+
+
+def _acc_dim_threshold() -> int:
+    """The SL601 reduction-extent threshold — the registry-declared
+    ``HEAT_TPU_NUMCHECK_ACC_DIM`` knob (read-only analyzer tuning:
+    changes which findings fire, never any program)."""
+    from ..core import gates
+
+    raw = gates.get("HEAT_TPU_NUMCHECK_ACC_DIM", "1024")
+    try:
+        return max(1, int(raw))
+    except (TypeError, ValueError):
+        return 1024
+
+
+def _pragmas_of(src: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _NUMCHECK_PRAGMA.search(line)
+        if m:
+            out[i] = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+    return out
+
+
+def fn_pragmas(fn) -> FrozenSet[str]:
+    """Rule ids a ``# numcheck: ignore[...]`` pragma anywhere in the
+    checked function's source suppresses — function-level coverage
+    (the IR findings carry no source lines to anchor finer). Returns
+    an empty set when source is unavailable."""
+    import inspect
+
+    try:
+        src = inspect.getsource(inspect.unwrap(fn))
+    except (TypeError, OSError, AttributeError):
+        return frozenset()
+    rules: Set[str] = set()
+    for toks in _pragmas_of(src).values():
+        rules |= toks
+    return frozenset(rules)
+
+
+# --------------------------------------------------------------------- #
+# the jaxpr walk (SL601 / SL602 / SL603)                                #
+# --------------------------------------------------------------------- #
+def _index_jaxpr(jaxpr):
+    """One pass over every (sub-)jaxpr: the eqn list in traversal order
+    and the producer map keyed ``id(var)`` (vars are unique objects, so
+    the map lets backward walks cross call boundaries — the ircheck
+    narrowing-arm idiom)."""
+    from .ircheck import _as_jaxprs
+    from jax.extend import core as jex_core
+
+    eqns = []
+    producers: Dict[int, Any] = {}
+    todo, seen = [jaxpr], set()
+    while todo:
+        jx = todo.pop()
+        if id(jx) in seen:
+            continue
+        seen.add(id(jx))
+        for eqn in jx.eqns:
+            eqns.append(eqn)
+            for ov in eqn.outvars:
+                producers[id(ov)] = eqn
+            for val in eqn.params.values():
+                todo.extend(_as_jaxprs(val, jex_core))
+    return eqns, producers
+
+
+def _extent(shape, dims) -> int:
+    n = 1
+    for d in dims:
+        n *= int(shape[int(d)])
+    return n
+
+
+def _is_literal(v) -> bool:
+    from jax.extend import core as jex_core
+
+    return isinstance(v, jex_core.Literal)
+
+
+def _precision_is_highest(prec) -> bool:
+    """Does a ``dot_general`` precision param guarantee exact f32 MXU
+    products? The stamped forms carry ``Precision.HIGHEST`` (possibly
+    as a per-operand pair); ``None`` is the platform default — bf16
+    passes on TPU."""
+    return prec is not None and "HIGHEST" in str(prec).upper()
+
+
+def _scan_sl601(eqns, threshold: int, findings: List[Finding]) -> None:
+    seen = set()
+
+    def fire(op: str, dt, extent: int, fix: str) -> None:
+        key = (op, np.dtype(dt).name, extent)
+        if key in seen:
+            return
+        seen.add(key)
+        severity = "error" if extent >= _SL601_ERROR_EXTENT else "warning"
+        findings.append(
+            Finding(
+                "SL601",
+                severity,
+                f"low-precision accumulation: a {op} accumulates in "
+                f"{np.dtype(dt).name} over a reduction extent of {extent} "
+                f"(threshold {threshold}) — each step compounds ~1e-2 "
+                f"relative error; {fix}",
+                op=op,
+            )
+        )
+
+    for eqn in eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            # accumulation dtype = preferred_element_type when stamped,
+            # else the output aval (the MXU accumulates in the out type)
+            acc_dt = eqn.params.get("preferred_element_type")
+            if acc_dt is None:
+                acc_dt = eqn.outvars[0].aval.dtype
+            if not _dtypes.is_low_precision(acc_dt):
+                continue
+            (lhs_contract, _), _ = eqn.params["dimension_numbers"]
+            extent = _extent(eqn.invars[0].aval.shape, lhs_contract)
+            if extent >= threshold:
+                fire(
+                    "dot_general", acc_dt, extent,
+                    "pass preferred_element_type=jnp.float32 (accumulate "
+                    "f32, store narrow)",
+                )
+        elif name in ("reduce_sum", "reduce"):
+            # reduce_sum carries axes=; the generic monoid reduce
+            # (lax.reduce with an add computation) carries dimensions=
+            in_dt = eqn.invars[0].aval.dtype
+            if not _dtypes.is_low_precision(in_dt):
+                continue
+            if name == "reduce":
+                body = eqn.params.get("jaxpr")
+                body_eqns = getattr(getattr(body, "jaxpr", body), "eqns", [])
+                if [e.primitive.name for e in body_eqns] != ["add"]:
+                    continue  # min/max/etc monoids don't accumulate error
+                dims = eqn.params.get("dimensions", ())
+            else:
+                dims = eqn.params.get("axes", ())
+            extent = _extent(eqn.invars[0].aval.shape, dims)
+            if extent >= threshold:
+                fire(
+                    name, in_dt, extent,
+                    "upcast the operand (.astype(jnp.float32)) before the "
+                    "sum and narrow the result",
+                )
+        elif name == "scan":
+            length = int(eqn.params.get("length") or 0)
+            if length < threshold:
+                continue
+            sub = eqn.params.get("jaxpr")
+            in_avals = getattr(sub, "in_avals", None)
+            if in_avals is None:
+                continue
+            ncon = int(eqn.params.get("num_consts", 0))
+            ncar = int(eqn.params.get("num_carry", 0))
+            for aval in in_avals[ncon : ncon + ncar]:
+                dt = getattr(aval, "dtype", None)
+                if dt is not None and _dtypes.is_low_precision(dt):
+                    fire(
+                        "scan", dt, length,
+                        "carry the accumulator in float32 and cast only "
+                        "the per-step payload",
+                    )
+
+
+def _scan_sl602(eqns, producers, pragmas: FrozenSet[str], findings: List[Finding]) -> None:
+    def collect_dots(v, depth: int = 0, visited=None):
+        """The dot_general producers a value resolves to (keyed by eqn
+        identity — eqn objects are not reliably hashable), walking back
+        through the arithmetic of the Gauss form (sub/add/neg) and the
+        shape-transparent primitives."""
+        if visited is None:
+            visited = set()
+        if depth > 8 or _is_literal(v) or id(v) in visited:
+            return {}
+        visited.add(id(v))
+        src = producers.get(id(v))
+        if src is None:
+            return {}
+        name = src.primitive.name
+        if name == "dot_general":
+            return {id(src): src}
+        if name in _PASSTHROUGH or name in ("sub", "add", "neg", "mul"):
+            out = {}
+            for u in src.invars:
+                out.update(collect_dots(u, depth + 1, visited))
+            return out
+        return {}
+
+    def operand_roots(dot_eqn) -> Set[int]:
+        """Terminal ancestor var ids of a dot's operands (walked through
+        the shape-transparent primitives and adds — ``ar + ai`` shares
+        the roots of both addends, which is exactly how the Gauss form
+        shares operands between its three products)."""
+        roots: Set[int] = set()
+        stack = [(u, 0) for u in dot_eqn.invars]
+        visited: Set[int] = set()
+        while stack:
+            v, depth = stack.pop()
+            if depth > 8 or _is_literal(v) or id(v) in visited:
+                continue
+            visited.add(id(v))
+            src = producers.get(id(v))
+            if src is None or src.primitive.name not in (
+                _PASSTHROUGH | {"add", "sub", "neg"}
+            ):
+                roots.add(id(v))
+                continue
+            stack.extend((u, depth + 1) for u in src.invars)
+        return roots
+
+    seen = set()
+    for eqn in eqns:
+        if eqn.primitive.name != "sub":
+            continue
+        dots_l = collect_dots(eqn.invars[0])
+        dots_r = collect_dots(eqn.invars[1])
+        if not dots_l or not dots_r:
+            continue
+        merged = dict(dots_l)
+        merged.update(dots_r)
+        dots = list(merged.values())
+        if len(dots) < 2:
+            continue
+        shared = False
+        for dl in dots_l.values():
+            rl = operand_roots(dl)
+            for dr in dots_r.values():
+                if dl is dr:
+                    continue
+                if rl & operand_roots(dr):
+                    shared = True
+                    break
+            if shared:
+                break
+        if not shared:
+            continue
+        key = frozenset(merged)
+        if key in seen:
+            continue
+        seen.add(key)
+        all_highest = all(
+            _precision_is_highest(d.params.get("precision")) for d in dots
+        )
+        out_dt = np.dtype(eqn.outvars[0].aval.dtype)
+        if all_highest:
+            findings.append(
+                Finding(
+                    "SL602",
+                    "info",
+                    "cancellation-prone form at precision=HIGHEST: a "
+                    f"subtraction of {len(dots)} products sharing an operand "
+                    "(the Gauss 3-multiply shape) — exact f32 MXU products, "
+                    "the sanctioned lowering of the planar-complex policy",
+                    op="sub",
+                )
+            )
+        else:
+            severity = "info" if "SL602" in pragmas else "error"
+            findings.append(
+                Finding(
+                    "SL602",
+                    severity,
+                    "cancellation-prone form at DEFAULT precision: a "
+                    f"{out_dt.name} subtraction of {len(dots)} products "
+                    "sharing an operand (the Gauss 3-multiply shape) — on "
+                    "TPU the products run as bf16 MXU passes (~1e-2 "
+                    "relative) and the cancellation amplifies that into "
+                    "catastrophic relative error (the planar-complex 13% "
+                    "on-chip defect). Stamp the dots precision='highest' "
+                    "(jax.lax.Precision.HIGHEST), or annotate "
+                    "`# numcheck: ignore[SL602] -- reason` if the inputs "
+                    "provably cannot cancel",
+                    op="sub",
+                )
+            )
+
+
+def _scan_sl603(jaxpr, eqns, producers, findings: List[Finding]) -> None:
+    low = _dtypes.is_low_precision
+
+    def deriving_lowcast(v, depth_cap: int = 8):
+        """The convert_element_type eqn (>=32-bit float → bf16/f16)
+        a value resolves to through the shape-transparent primitives."""
+        stack, visited = [(v, 0)], set()
+        while stack:
+            u, depth = stack.pop()
+            if depth > depth_cap or _is_literal(u) or id(u) in visited:
+                continue
+            visited.add(id(u))
+            src = producers.get(id(u))
+            if src is None:
+                continue
+            name = src.primitive.name
+            if name == "convert_element_type":
+                src_dt = np.dtype(src.invars[0].aval.dtype)
+                dst_dt = np.dtype(src.params.get("new_dtype"))
+                if (
+                    src_dt.kind == "f"
+                    and _dtypes.effective_itemsize(src_dt) >= 4
+                    and low(dst_dt)
+                ):
+                    return src
+                continue
+            if name in _PASSTHROUGH:
+                stack.extend((w, depth + 1) for w in src.invars)
+        return None
+
+    def fire(dst_dt, src_dt, what: str) -> None:
+        findings.append(
+            Finding(
+                "SL603",
+                "error",
+                f"low-precision cast feeds a loop-carried accumulator: a "
+                f"{src_dt.name} value is cast to {dst_dt.name} and {what} — "
+                "the accumulator loses ~3 decimal digits per lap (the "
+                "KMeans bf16-counts class, and the death of an EF carry: "
+                "the residual it stores IS the low-order bits the cast "
+                "throws away). Keep the carry in float32; cast only the "
+                "transient wire/compute payload",
+                op="convert_element_type",
+            )
+        )
+
+    # arm A: a low-precision cast feeding a scan/while carry slot
+    for eqn in eqns:
+        name = eqn.primitive.name
+        if name == "scan":
+            ncon = int(eqn.params.get("num_consts", 0))
+            ncar = int(eqn.params.get("num_carry", 0))
+            carry_ins = eqn.invars[ncon : ncon + ncar]
+        elif name == "while":
+            ncon = int(eqn.params.get("cond_nconsts", 0)) + int(
+                eqn.params.get("body_nconsts", 0)
+            )
+            carry_ins = eqn.invars[ncon:]
+        else:
+            continue
+        for cv in carry_ins:
+            dt = getattr(getattr(cv, "aval", None), "dtype", None)
+            if dt is None or not low(dt):
+                continue
+            conv = deriving_lowcast(cv)
+            if conv is not None:
+                fire(
+                    np.dtype(conv.params.get("new_dtype")),
+                    np.dtype(conv.invars[0].aval.dtype),
+                    f"carried through a {name} loop",
+                )
+
+    # arm B: the CROSS-program carry (EF residuals, running means ride
+    # ht.jit boundaries, so no in-jaxpr loop exists): a program OUTPUT
+    # down-cast to bf16/f16 whose shape matches a float32 input it
+    # derives from — the caller feeds it back next step
+    float_ins = [
+        v
+        for v in jaxpr.invars
+        if getattr(getattr(v, "aval", None), "dtype", None) is not None
+        and np.dtype(v.aval.dtype).kind == "f"
+        and _dtypes.effective_itemsize(v.aval.dtype) >= 4
+    ]
+    for ov in jaxpr.outvars:
+        src = producers.get(id(ov))
+        if src is None or src.primitive.name != "convert_element_type":
+            continue
+        src_dt = np.dtype(src.invars[0].aval.dtype)
+        dst_dt = np.dtype(src.params.get("new_dtype"))
+        if not (src_dt.kind == "f" and _dtypes.effective_itemsize(src_dt) >= 4 and low(dst_dt)):
+            continue
+        shape = tuple(ov.aval.shape)
+        matches = [v for v in float_ins if tuple(v.aval.shape) == shape]
+        if not matches:
+            continue
+        # does the cast value DERIVE from one of the shape-matched
+        # inputs? generic dataflow walk, call eqns step both onto their
+        # operands and (index-matched) into their sub-jaxprs
+        want = {id(v) for v in matches}
+        stack, visited, derives = [(src.invars[0], 0)], set(), False
+        while stack and not derives:
+            v, depth = stack.pop()
+            if depth > 25 or _is_literal(v) or id(v) in visited:
+                continue
+            visited.add(id(v))
+            if id(v) in want:
+                derives = True
+                break
+            producer = producers.get(id(v))
+            if producer is None:
+                continue
+            stack.extend((u, depth + 1) for u in producer.invars)
+        if derives:
+            fire(dst_dt, src_dt, "returned shape-matching the float32 input it derives from (a cross-step carry)")
+
+
+def scan_jaxpr_precision(
+    closed,
+    label: str = "",
+    acc_dim: Optional[int] = None,
+    pragmas: FrozenSet[str] = frozenset(),
+) -> List[Finding]:
+    """The pass-6 IR rules (SL601–SL603) over one (closed) jaxpr —
+    what :func:`ht.analysis.check` folds in and :func:`numcheck` runs
+    standalone. Pure jaxpr walk: descends pjit/scan/cond/shard_map
+    bodies through the shared producer map, never executes anything."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    threshold = acc_dim if acc_dim is not None else _acc_dim_threshold()
+    findings: List[Finding] = []
+    eqns, producers = _index_jaxpr(jaxpr)
+    _scan_sl601(eqns, threshold, findings)
+    _scan_sl602(eqns, producers, pragmas, findings)
+    _scan_sl603(jaxpr, eqns, producers, findings)
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# the source scans (SL604 + the SL602 policy arm)                       #
+# --------------------------------------------------------------------- #
+_F64_NAMES = ("float64", "complex128")
+
+
+def scan_precision_source(fn, x64_enabled: Optional[bool] = None) -> List[Finding]:
+    """Rule SL604: f64 requests in the checked program's SOURCE under
+    the x64-disabled platform policy (core/devices.py). The jaxpr
+    cannot carry this rule — with x64 off the request silently degrades
+    to f32 AT TRACE TIME, so the trace shows float32 and the precision
+    loss is invisible downstream. Best-effort like srclint's host-sync
+    scan: silently returns [] when source is unavailable.
+
+    ``x64_enabled`` defaults to the live :func:`core.devices.use_x64`
+    policy (True on cpu/gpu, False on TPU — where the rule matters);
+    pass an explicit bool to audit for a target platform.
+    """
+    import inspect
+    import textwrap
+
+    if x64_enabled is None:
+        from ..core import devices
+
+        x64_enabled = devices.use_x64()
+    if x64_enabled:
+        return []  # 64-bit requests are honored: nothing degrades
+
+    target = inspect.unwrap(fn)
+    try:
+        src = textwrap.dedent(inspect.getsource(target))
+        tree = ast.parse(src)
+        base = inspect.getsourcefile(target) or "<source>"
+        first = target.__code__.co_firstlineno if hasattr(target, "__code__") else 1
+    except (TypeError, OSError, SyntaxError, AttributeError):
+        return []
+    pragmas = _pragmas_of(src)
+    suppressed = {r for toks in pragmas.values() for r in toks}
+    if "SL604" in suppressed or "*" in suppressed:
+        return []
+    findings: List[Finding] = []
+    seen_lines: Set[int] = set()
+    for node in ast.walk(tree):
+        name = None
+        if isinstance(node, ast.Attribute) and node.attr in _F64_NAMES:
+            name = node.attr
+        elif isinstance(node, ast.Name) and node.id in _F64_NAMES:
+            name = node.id
+        elif isinstance(node, ast.Constant) and node.value in _F64_NAMES:
+            name = node.value
+        if name is None or node.lineno in seen_lines:
+            continue
+        seen_lines.add(node.lineno)
+        findings.append(
+            Finding(
+                "SL604",
+                "warning",
+                f"f64 request ({name}) under the x64-disabled platform "
+                "policy — the dtype silently degrades to float32 at trace "
+                "time (core/devices.py: TPU runs with x64 off; "
+                "types.degrade64). If the extra precision is load-bearing, "
+                "call ht.use_x64(True) explicitly; otherwise request "
+                "float32 and make the narrowing visible",
+                path=base,
+                line=first + node.lineno - 1,
+                op=name,
+            )
+        )
+    return findings
+
+
+def _defaults_highest(fn_node: ast.FunctionDef) -> bool:
+    """Does the op guarantee ``precision="highest"`` when the caller
+    passes nothing — a ``precision="highest"`` default parameter, or
+    the ``if precision is None: precision = "highest"`` resolution?"""
+    args = fn_node.args
+    names = [a.arg for a in args.args + args.kwonlyargs]
+    defaults = list(args.defaults) + list(args.kw_defaults)
+    pos_with_default = args.args[len(args.args) - len(args.defaults):] if args.defaults else []
+    for a, dflt in list(zip(pos_with_default, args.defaults)) + list(
+        zip(args.kwonlyargs, args.kw_defaults)
+    ):
+        if (
+            a.arg == "precision"
+            and isinstance(dflt, ast.Constant)
+            and str(dflt.value).lower() == "highest"
+        ):
+            return True
+    if "precision" not in names:
+        return False
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign):
+            tgts = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if (
+                "precision" in tgts
+                and isinstance(node.value, ast.Constant)
+                and str(node.value.value).lower() == "highest"
+            ):
+                return True
+    return False
+
+
+def _delegates_to_highest(fn_node: ast.FunctionDef) -> bool:
+    """Does the op route through a sibling policy-"highest" op (a BARE
+    name call — ``matmul(a, b)``; attribute calls like ``jnp.matmul``
+    are the raw primitive, not the policy surface)?"""
+    highest = {op for op, pol in PLANAR_PRECISION_POLICY.items() if pol == "highest"}
+    for node in ast.walk(fn_node):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in highest
+            and node.func.id != fn_node.name
+        ):
+            return True
+    return False
+
+
+def lint_source(src: str, rel: str) -> List[Finding]:
+    """The SL602 source arm over one module: every op
+    :data:`PLANAR_PRECISION_POLICY` marks "highest" must default its
+    MXU precision to HIGHEST (or delegate to a sibling op that does).
+    Scoped to core/complex_planar.py — the module whose Gauss
+    decomposition IS the cancellation-prone form; other modules return
+    no findings."""
+    rel = rel.replace("\\", "/")
+    if not rel.endswith(_PLANAR_MODULE):
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("SL602", "error", f"unparseable module: {e}", path=rel, line=e.lineno)]
+    pragmas = _pragmas_of(src)
+    top_fns = {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
+    findings: List[Finding] = []
+    for op in sorted(PLANAR_PRECISION_POLICY):
+        if PLANAR_PRECISION_POLICY[op] != "highest":
+            continue
+        fn_node = top_fns.get(op)
+        if fn_node is None:
+            findings.append(
+                Finding(
+                    "SL602",
+                    "error",
+                    f"PLANAR_PRECISION_POLICY names op {op!r} 'highest' but "
+                    f"{_PLANAR_MODULE} defines no such function — the policy "
+                    "table and the module drifted apart",
+                    path=rel,
+                    line=1,
+                )
+            )
+            continue
+        if _defaults_highest(fn_node) or _delegates_to_highest(fn_node):
+            continue
+        toks = pragmas.get(fn_node.lineno, set())
+        severity = "info" if ("SL602" in toks or "*" in toks) else "error"
+        findings.append(
+            Finding(
+                "SL602",
+                severity,
+                f"planar op {op!r} does not default precision to 'highest': "
+                "the Gauss 3-multiply form recovers the imaginary part by "
+                "cancellation of MXU products, which default (bf16) "
+                "precision turns into up to 13% relative error on chip — "
+                "the PR 5 live defect. Restore the `if precision is None: "
+                "precision = \"highest\"` default (callers opt INTO speed "
+                "explicitly)",
+                path=rel,
+                line=fn_node.lineno,
+            )
+        )
+    findings.sort(key=lambda f: (f.path or "", f.line or 0, f.rule))
+    return findings
+
+
+def lint_paths(paths, root: Optional[str] = None) -> AnalysisReport:
+    """The ``--pass numcheck`` tree arm: run :func:`lint_source` over
+    every ``.py`` file under ``paths`` (relative anchors against
+    ``root``). Today this is the planar precision-policy enforcement —
+    the IR rules need example arguments and ride
+    :func:`ht.analysis.check` / :func:`numcheck` instead."""
+    import os
+
+    from .srclint import _iter_py_files
+
+    root = os.path.abspath(root or os.getcwd())
+    findings: List[Finding] = []
+    n_files = 0
+    for path in paths:
+        for fp in _iter_py_files(path):
+            n_files += 1
+            with open(fp, encoding="utf-8") as f:
+                src = f.read()
+            rel = os.path.relpath(os.path.abspath(fp), root).replace(os.sep, "/")
+            findings += lint_source(src, rel)
+    return AnalysisReport(findings, context={"files": n_files, "pass": "numcheck"})
+
+
+# --------------------------------------------------------------------- #
+# the standalone entry                                                  #
+# --------------------------------------------------------------------- #
+def numcheck(
+    fn,
+    *args,
+    acc_dim: Optional[int] = None,
+    x64: Optional[bool] = None,
+    **kwargs,
+) -> AnalysisReport:
+    """Precision-flow analysis of the program ``fn(*args, **kwargs)``
+    compiles to (analyzer pass 6, standalone).
+
+    Same calling contract as :func:`ht.analysis.check`: ``fn`` may be a
+    public heat_tpu function over DNDarrays, an ``ht.jit`` wrapper, or
+    a jax callable; the arguments are example inputs fixing
+    shapes/dtypes. Compile-only — nothing executes on device. Runs the
+    SL601–SL603 jaxpr rules plus the SL604 f64-policy source scan (the
+    one rule :func:`check` cannot fold: with x64 off the request
+    degrades at trace time and never reaches the jaxpr).
+
+    Parameters
+    ----------
+    acc_dim : SL601 reduction-extent threshold override (default: the
+        ``HEAT_TPU_NUMCHECK_ACC_DIM`` gate, 1024).
+    x64 : SL604 policy override — audit as if the x64 policy were
+        this value (default: the live ``core.devices.use_x64()``).
+
+    Returns an :class:`AnalysisReport`; ``report.ok`` is False iff an
+    error-severity finding gates.
+    """
+    from .ircheck import _lower_checked
+
+    findings: List[Finding] = []
+    threshold = acc_dim if acc_dim is not None else _acc_dim_threshold()
+    context: Dict[str, Any] = {"pass": "numcheck", "acc_dim": int(threshold)}
+    findings += scan_precision_source(fn, x64_enabled=x64)
+    lowered = _lower_checked(fn, args, kwargs, findings)
+    if lowered is not None:
+        closed, _compiled = lowered
+        findings += scan_jaxpr_precision(
+            closed,
+            label=getattr(fn, "__name__", "") or "",
+            acc_dim=threshold,
+            pragmas=fn_pragmas(fn),
+        )
+    findings.sort(
+        key=lambda f: ({"error": 0, "warning": 1, "info": 2}[f.severity], f.rule)
+    )
+    return AnalysisReport(findings, context)

@@ -76,6 +76,21 @@ well-formed, invariant by invariant:
     recorded ici/dcn ratio; the staging model recompute above uses the
     recorded pcie/hbm prices). Environment-independent: a dumped
     calibrated plan verifies on a container with no profile.
+``tolerance``
+    the error-bound recomputation (ISSUE 17, pass 6's dynamic half):
+    the end-to-end error bound recomputed from the recorded per-step
+    tolerances — each quantize step contributes the codec's pinned
+    ``tolerance(mode)`` to the disjoint payload leg it encodes (one
+    ``(overlap, chunk)`` lap, one ring hop block, one standalone
+    phase), staging/relayout/overlap steps are exact-bit, and in a
+    hierarchical plan only dcn-tier crossings may carry the codec (the
+    PR 8 policy) — must equal the schedule-level ``quant.tol``
+    annotation, which itself must equal
+    ``kernels.quant.tolerance(mode)``. Every encoded crossing must be
+    codec-sandwiched and attributed (``[<mode> wire]``), and no
+    exact-bit plan may claim one. Available standalone as
+    :func:`check_tolerance` (SL605 findings) — the budget contract the
+    Newton–Schulz and MPMD tolerance consumers read.
 ``plan-id``
     the ``plan_id`` is the sha1 of the canonical serialization — a
     hand-edited or bit-rotted dump cannot keep its id.
@@ -92,7 +107,10 @@ import json
 
 from typing import Any, Dict, List, Optional, Tuple, Union
 
-__all__ = ["PlanVerificationError", "check_progress", "verify_plan"]
+__all__ = [
+    "PlanVerificationError", "check_progress", "check_tolerance",
+    "verify_plan",
+]
 
 _COLLECTIVE_KINDS = ("all_to_all", "all_gather", "ppermute")
 _LOCAL_KINDS = (
@@ -114,7 +132,7 @@ class PlanVerificationError(ValueError):
     invariant : the violated invariant's name (``composition``,
         ``conservation``, ``accounting``, ``quant-pairing``,
         ``tier-labels``, ``overlap-structure``, ``staging``,
-        ``progress``, ``plan-id``, ``step-kinds``).
+        ``progress``, ``tolerance``, ``plan-id``, ``step-kinds``).
     detail : what exactly failed, with the offending numbers.
     plan_id : the plan's id when known.
     """
@@ -977,6 +995,10 @@ def verify_plan(
     for _rule, defect in _progress_defects(d, steps, coll, p, strategy, topo):
         fail("progress", defect)
 
+    # ---- tolerance: the error-bound recomputation (ISSUE 17) ----------
+    for defect in _tolerance_defects(d, steps, quant, strategy, topo):
+        fail("tolerance", defect)
+
     # ---- plan-id: the sha1 of the canonical serialization -------------
     if plan_id is not None:
         stripped = {k: v for k, v in d.items() if k != "plan_id"}
@@ -992,7 +1014,7 @@ def verify_plan(
     checks = [
         "step-kinds", "accounting", "quant-pairing", "tier-labels",
         "composition", "conservation", "overlap-structure", "staging",
-        "calibration", "progress", "plan-id",
+        "calibration", "progress", "tolerance", "plan-id",
     ]
     return {
         "ok": not violations,
@@ -1122,6 +1144,206 @@ def _progress_defects(
                         "unissued lap",
                     ))
     return defects
+
+
+# --------------------------------------------------------------------- #
+# the tolerance recomputation (ISSUE 17 — pass 6's dynamic half)        #
+# --------------------------------------------------------------------- #
+def _wire_claim(detail: str) -> Optional[str]:
+    """The codec mode a collective step's detail claims (the planner's
+    ``" [<mode> wire]"`` suffix), or None for an exact-bit wire."""
+    for m in ("int8", "bf16"):
+        if detail.endswith(f" [{m} wire]"):
+            return m
+    return None
+
+
+def _tolerance_defects(d, steps, quant, strategy, topo) -> List[str]:
+    """Every tolerance-budget defect of one plan dict, step-named.
+
+    The recomputation: each ``quantize`` step contributes the codec's
+    pinned ``tolerance(mode)`` to the payload leg it encodes (the lossy
+    rounding happens at encode — the collective ships the encoded bits
+    verbatim and the dequantize is exact given them); every other step
+    kind — slice/concat/pack/unpack/reshape relayouts, staging
+    transfers, overlap bookkeeping — is an exact-bit copy contributing
+    0.0. Payload legs are disjoint: a pipelined exchange encodes each
+    ``(overlap, chunk)`` lap once, a ring encodes each positional hop
+    block once, and in a hierarchical plan only the ``tier="dcn"``
+    crossings carry a codec at all (the PR 8 policy — the ICI pivot
+    ships exact). ``compose_tolerance`` over a leg therefore yields
+    exactly ``tolerance(mode)``, and the end-to-end bound — the max
+    over disjoint legs — must equal the schedule-level ``quant.tol``
+    annotation (0.0 with no annotation). Cross-iteration accumulation
+    is the DP optimizer's error-feedback contract (the f32 EF carry in
+    optim/dp_optimizer.py — rule SL603 guards its dtype), not a plan
+    property.
+    """
+    defects: List[str] = []
+    q_idx = [k for k, st in enumerate(steps) if st.get("kind") == "quantize"]
+    claiming = [
+        k
+        for k, st in enumerate(steps)
+        if st.get("kind") in _COLLECTIVE_KINDS
+        and _wire_claim(st.get("detail") or "")
+    ]
+    mode = (quant or {}).get("mode")
+    if not quant:
+        # exact-bit plan: no collective may claim an encoded wire (the
+        # codec-step census itself is quant-pairing's invariant)
+        for k in claiming:
+            defects.append(
+                f"step [{k}] ({steps[k].get('kind')}) claims an encoded "
+                f"wire ('{_wire_claim(steps[k].get('detail') or '')} wire') "
+                "but the plan declares no quant annotation — an undeclared "
+                "lossy crossing has no tolerance budget"
+            )
+        return defects
+    if mode not in ("int8", "bf16"):
+        return defects  # quant-pairing owns the mode vocabulary
+
+    from ..kernels import quant as _quant
+
+    step_tol = float(_quant.tolerance(mode))
+    try:
+        declared = float(quant.get("tol"))
+    except (TypeError, ValueError):
+        defects.append(
+            f"quant annotation tol={quant.get('tol')!r} is not a number"
+        )
+        return defects
+    if declared != step_tol:
+        defects.append(
+            f"quant annotation tol={declared!r} != the {mode} codec's "
+            f"pinned tolerance {step_tol!r} (kernels.quant.tolerance) — "
+            "the declared budget does not match what the codec guarantees"
+        )
+
+    sandwiched: List[int] = []
+    for k in q_idx:
+        st = steps[k]
+        det = st.get("detail") or ""
+        if not det.startswith(f"{mode}-encode wire blocks"):
+            defects.append(
+                f"step [{k}] (quantize) detail {det[:40]!r}... does not "
+                f"record a {mode} encode — the step's tolerance "
+                "contribution cannot be attributed to the declared codec"
+            )
+        nxt = steps[k + 1] if k + 1 < len(steps) else None
+        if nxt is None or nxt.get("kind") not in _COLLECTIVE_KINDS:
+            continue  # the sandwich structure itself is quant-pairing's
+        if (
+            nxt.get("chunk") != st.get("chunk")
+            or nxt.get("overlap") != st.get("overlap")
+        ):
+            defects.append(
+                f"step [{k}] (quantize) encodes leg "
+                f"(overlap={st.get('overlap')!r}, chunk={st.get('chunk')!r}) "
+                f"but the collective it feeds, step [{k + 1}] "
+                f"({nxt.get('kind')}), ships "
+                f"(overlap={nxt.get('overlap')!r}, chunk={nxt.get('chunk')!r}) "
+                "— the encoded payload and the wire crossing disagree, so "
+                "the per-leg composition is unprovable"
+            )
+        sandwiched.append(k + 1)
+        ndet = nxt.get("detail") or ""
+        if _wire_claim(ndet) != mode:
+            defects.append(
+                f"step [{k + 1}] ({nxt.get('kind')}) rides between a "
+                f"quantize/dequantize pair but does not claim the "
+                f"'[{mode} wire]' — the encoded crossing is unattributed"
+            )
+        if topo is not None and strategy == "hierarchical-a2a" and nxt.get("tier") != "dcn":
+            defects.append(
+                f"step [{k + 1}] ({nxt.get('kind')}, tier="
+                f"{nxt.get('tier')!r}) carries the codec in a hierarchical "
+                "plan — the codec policy charges only dcn-tier legs (the "
+                "ICI pivot ships exact-bit), so an encoded "
+                f"{nxt.get('tier')!r} crossing spends tolerance the "
+                "annotation never budgeted"
+            )
+        nxt2 = steps[k + 2] if k + 2 < len(steps) else None
+        if nxt2 is not None and nxt2.get("kind") == "dequantize":
+            ddet = nxt2.get("detail") or ""
+            if not ddet.startswith(f"{mode}-decode"):
+                defects.append(
+                    f"step [{k + 2}] (dequantize) detail {ddet[:40]!r}... "
+                    f"does not record a {mode} decode — the round-trip "
+                    "this leg's tolerance bound prices is not the one "
+                    "recorded"
+                )
+
+    for k in claiming:
+        if k not in sandwiched:
+            defects.append(
+                f"step [{k}] ({steps[k].get('kind')}) claims an encoded "
+                "wire but is not quantize/dequantize-sandwiched — a "
+                "crossing outside the codec pairing carries no budgeted "
+                "tolerance"
+            )
+
+    # ---- per-leg composition: each disjoint payload leg crosses the
+    # codec once, so compose_tolerance over its encodes must equal the
+    # per-crossing pin; the end-to-end bound is the max over legs
+    legs: Dict[Any, List[float]] = {}
+    for k in q_idx:
+        st = steps[k]
+        tag, chunk = st.get("overlap"), st.get("chunk")
+        if chunk is not None:
+            key = (tag, chunk)
+        elif tag is not None:
+            nxt = steps[k + 1] if k + 1 < len(steps) else {}
+            if nxt.get("kind") == "ppermute":
+                key = (tag, "hop", k)  # ring hops ship disjoint blocks
+            else:
+                key = (tag, None)
+        else:
+            key = ("solo", k)  # standalone sandwich = its own phase
+        legs.setdefault(key, []).append(step_tol)
+    for key in sorted(legs, key=repr):
+        if len(legs[key]) > 1:
+            tag, chunk = key[0], key[1]
+            defects.append(
+                f"payload leg (overlap={tag!r}, chunk={chunk!r}) is "
+                f"encoded {len(legs[key])} times — its composed bound "
+                f"{_quant.compose_tolerance(legs[key])!r} exceeds the "
+                f"declared per-crossing budget {declared!r} (double-encode)"
+            )
+    composed = max(
+        (_quant.compose_tolerance(tols) for tols in legs.values()),
+        default=0.0,
+    )
+    if q_idx and not defects and composed != declared:
+        defects.append(
+            f"end-to-end composed bound {composed!r} != the declared "
+            f"quant.tol {declared!r}"
+        )
+    return defects
+
+
+def check_tolerance(plan) -> list:
+    """The plan-side tolerance-budget check (pass 6's dynamic half),
+    standalone: recompute one plan's end-to-end error bound from its
+    recorded per-step tolerances and return an error-severity SL605
+    finding per defect — empty means the composed bound provably equals
+    the schedule-level ``quant.tol`` annotation (0.0 for exact-bit
+    plans). The same recomputation gates ``verify_plan`` under the
+    ``tolerance`` invariant; this entry point mirrors
+    :func:`check_progress` so the golden-dump sweeps (and the
+    Newton–Schulz / MPMD tolerance-budget consumers the ROADMAP names)
+    can collect findings instead of catching exceptions."""
+    from .findings import Finding
+
+    d = _as_plan_dict(plan)
+    steps = list(d.get("steps") or [])
+    defects = _tolerance_defects(
+        d, steps, d.get("quant"), d.get("strategy", ""), d.get("topology")
+    )
+    plan_id = d.get("plan_id")
+    return [
+        Finding("SL605", "error", f"plan {plan_id}: {defect}")
+        for defect in defects
+    ]
 
 
 def check_progress(plan) -> list:

@@ -379,6 +379,19 @@ declare(GateSpec(
          "version-mismatched profile is evicted and the constants are "
          "used (never an error)",
 ))
+declare(GateSpec(
+    "HEAT_TPU_NUMCHECK_ACC_DIM", default=str(1024), kind="int",
+    affects_programs=False, scopes=(),
+    key_params=(),
+    accessors=("acc_dim_threshold",),
+    help="analyzer pass 6 (numcheck) SL601 reduction-extent threshold: "
+         "a dot_general/reduce_sum/scan carry accumulating in bf16/f16 "
+         "over a contraction/reduction extent >= this value fires "
+         "low-precision-accumulation (warning; >= 65536 escalates to "
+         "error regardless). Read-only analyzer tuning — changes which "
+         "findings a report carries, never any plan, plan_id, program, "
+         "or AOT key (affects_programs=False by construction)",
+))
 
 
 # --------------------------------------------------------------------- #

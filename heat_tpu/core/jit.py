@@ -530,5 +530,18 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
     # donation bookkeeping for ht.analysis.check (rule SL105): which
     # user-visible positional args this wrapper donates at dispatch
     wrapper._ht_jit_donate_argnums = donate_user
+
+    def _numcheck(*args, **kwargs):
+        """Precision-flow analysis (analyzer pass 6) of the program this
+        wrapper compiles for the given example arguments — compile-only
+        introspection, nothing dispatches and no cache entry is made.
+        ``wrapped.numcheck(x)`` == ``ht.analysis.numcheck(fn, x)`` on
+        the undecorated function, so the SL604 source scan sees the
+        user's code, not the wrapper."""
+        from ..analysis.numcheck import numcheck as _nc
+
+        return _nc(fn, *args, **kwargs)
+
+    wrapper.numcheck = _numcheck
     _LIVE_WRAPPERS.add(wrapper)
     return wrapper

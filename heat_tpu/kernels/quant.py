@@ -73,6 +73,7 @@ from typing import Dict, Optional, Tuple
 __all__ = [
     "MODES",
     "TILE",
+    "compose_tolerance",
     "dp_step_model",
     "dp_step_model_2tier",
     "decode_blocks",
@@ -114,6 +115,23 @@ def tolerance(mode: str) -> float:
     tile (int8) / per element (bf16) for finite payloads, and exact
     round-trip for ±inf/NaN."""
     return _TOL[_check_mode(mode)]
+
+
+def compose_tolerance(tols) -> float:
+    """The end-to-end relative error bound of a payload element that
+    traverses codec legs with per-leg tolerances ``tols``: first-order
+    composition ``sum(tols)`` (each leg adds at most its tol relative
+    to the governing absmax; cross terms are O(tol²), below the pinned
+    bounds' resolution). An element that crosses the wire once under
+    one mode therefore composes to exactly ``tolerance(mode)`` — the
+    identity the ``tolerance`` plan invariant
+    (:func:`ht.analysis.check_tolerance`) proves against the
+    schedule-level ``quant.tol`` annotation. Cross-ITERATION
+    composition is the DP optimizer's error-feedback contract
+    (optim/dp_optimizer.py keeps the residual carry in f32), not a
+    plan property. Empty ``tols`` (no codec leg) compose to 0.0:
+    staging/relayout/overlap steps are exact-bit."""
+    return float(sum(float(t) for t in tols))
 
 
 def _pad_tiles(n: int) -> int:

@@ -140,6 +140,7 @@ __all__ = [
     "overlap_mode",
     "plan",
     "planner_enabled",
+    "quant_tolerance",
     "resolve_topology",
     "tier_time_model",
     "wire_quant_gate",
@@ -251,6 +252,21 @@ def wire_quant_gate() -> Optional[str]:
     import jax
 
     return "int8" if jax.default_backend() == "tpu" else None
+
+
+def quant_tolerance(mode: Optional[str]) -> float:
+    """The per-crossing error bound the planner declares for plans it
+    quantizes under ``mode`` (the ``quant.tol`` annotation value) —
+    the codec's pinned tolerance, 0.0 for ``None`` (exact-bit wires).
+    Read-only delegation to :func:`heat_tpu.kernels.quant.tolerance`:
+    the planner annotates exactly what the codec guarantees, and the
+    ``tolerance`` plan invariant (ht.analysis.check_tolerance) proves
+    the dumped annotation still equals this recomputation."""
+    if mode is None:
+        return 0.0
+    from ..kernels import quant as _quant_mod
+
+    return float(_quant_mod.tolerance(mode))
 
 
 def _dcn_penalty() -> int:

@@ -499,6 +499,40 @@ class Schedule:
             tagged[i * lap_mult].chunk for i in range(len(tagged) // lap_mult)
         ]
 
+    # ------------------------------------------------------------------ #
+    # tolerance hooks (ISSUE 17): the per-step error bounds the         #
+    # ``tolerance`` invariant (ht.analysis.check_tolerance /            #
+    # verify_plan) composes end-to-end. Properties/methods only — like  #
+    # the congruence hooks above, they never touch the canonical        #
+    # serialization, so plan bytes and plan_ids are unchanged.          #
+    # ------------------------------------------------------------------ #
+    @property
+    def quant_tolerance(self) -> float:
+        """The schedule-level declared error bound: the wire codec's
+        pinned tolerance when the plan carries a quant annotation
+        (``2^-7`` int8, ``2^-8`` bf16 — kernels/quant.py), 0.0 for an
+        unquantized plan (every step exact-bit)."""
+        return float(self.quant["tol"]) if self.quant else 0.0
+
+    def step_tolerances(self) -> List[float]:
+        """Per-step relative error contribution, step-aligned with
+        ``self.steps``: ``tolerance(mode)`` on each quantize step (the
+        lossy rounding happens at encode; the wire and the dequantize
+        are exact given the encoded blocks), 0.0 everywhere else —
+        collectives move bits verbatim, staging/relayout/overlap steps
+        are exact-bit copies. ``compose_tolerance`` over the steps one
+        payload element traverses recovers the end-to-end bound the
+        ``tolerance`` invariant proves equal to ``quant_tolerance``."""
+        mode = self.quant.get("mode") if self.quant else None
+        if mode is None:
+            return [0.0] * len(self.steps)
+        from ..kernels import quant as _quant
+
+        tol = _quant.tolerance(mode)
+        return [
+            tol if s.kind == "quantize" else 0.0 for s in self.steps
+        ]
+
     def collective_counts(self) -> Dict[str, int]:
         """{HLO op name: count} the executed program must launch —
         directly comparable with

@@ -233,6 +233,10 @@ def compare(current: dict, baseline: dict, threshold: float) -> dict:
         "verdict": "regressed" if gating else "ok",
         "threshold": threshold,
         "compared": compared,
+        # suspect-flagged moves are excluded from the gate but COUNTED:
+        # a waived regression is data for eyes (re-run the bench), not
+        # silence — the r5 attention-MFU slip must stay visible
+        "waived": len(regressions) - len(gating),
         "regressions": regressions,
         "improvements": improvements,
         "new_rows": new_rows,

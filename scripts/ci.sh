@@ -216,26 +216,32 @@ print(f"chrome trace: {len(evs)} events OK")
 EOF
 rm -f "$trace_json"
 
-# the single CI lint entry (ISSUE 14): passes 2 + 4 + 5 — srclint
-# (SL2xx source hygiene), effectcheck (SL40x gate/cache-key staleness,
-# raw gate reads, lock discipline, pipeline protocol, swallowed worker
-# exceptions) and commcheck (SL504 unfenced dispatch entries) — in ONE
-# process, gated at error severity, with one SARIF document carrying
-# one run per pass for CI annotations. Exit codes are pinned
+# the single CI lint entry (ISSUE 14; ISSUE 17 adds pass 6): passes
+# 2 + 4 + 5 + 6 — srclint (SL2xx source hygiene), effectcheck (SL40x
+# gate/cache-key staleness, raw gate reads, lock discipline, pipeline
+# protocol, swallowed worker exceptions), commcheck (SL504 unfenced
+# dispatch entries) and numcheck (SL602 planar precision policy:
+# deleting the PR 5 precision="highest" default is an error here) — in
+# ONE process, gated at error severity, with one SARIF document
+# carrying one run per pass for CI annotations. Exit codes are pinned
 # format-invariant (tests/test_analysis.py::TestLintCLI): 0 on the
 # clean tree, 1 on any error-severity finding, text or sarif alike.
 python scripts/lint.py heat_tpu/ --pass all
 python scripts/lint.py heat_tpu/ --pass all --format sarif > /dev/null
-echo "lint --pass all: SL2xx/SL4xx/SL5xx clean + SARIF emitted"
+echo "lint --pass all: SL2xx/SL4xx/SL5xx/SL6xx clean + SARIF emitted"
 
-# seeded-bug proof (ISSUE 12 + 14 acceptance): each mutation removes
-# ONE invariant — a gate from a program-cache key (SL402), a lock
-# acquisition from a guarded dispatcher path (SL404), a pair from a
-# ring_all_gather permutation (SL502), the full-axis reduction off a
+# seeded-bug proof (ISSUE 12 + 14 + 17 acceptance): each mutation
+# removes ONE invariant — a gate from a program-cache key (SL402), a
+# lock acquisition from a guarded dispatcher path (SL404), a pair from
+# a ring_all_gather permutation (SL502), the full-axis reduction off a
 # collective-launching cond predicate (SL501), the epoch-fence call
-# off the executor / the serving endpoint (SL504) — and the lint must
-# trip on the mutated source with the invariant named.
-python -m pytest tests/test_effectcheck.py tests/test_commcheck.py -q -k "mutation" "$@"
+# off the executor / the serving endpoint (SL504), the planar
+# precision="highest" default (SL602), the gram builders' f32
+# accumulator (SL601), the f32 error-feedback carry (SL603), a golden
+# plan's tolerance annotation / encode tags / wire markers (the
+# tolerance invariant, step named) — and the lint must trip on the
+# mutated source with the invariant named.
+python -m pytest tests/test_effectcheck.py tests/test_commcheck.py tests/test_numcheck.py -q -k "mutation" "$@"
 
 # pass-5 IR + progress legs (ISSUE 14): the SL5xx golden bad fixtures
 # trip at their declared severities with clean twins, the shipped
@@ -243,6 +249,14 @@ python -m pytest tests/test_effectcheck.py tests/test_commcheck.py -q -k "mutati
 # to completion under the progress invariant, and a hand-mutated dump
 # fails scripts/verify_plans.py NAMING "progress" (the sweep test).
 python -m pytest tests/test_commcheck.py -q "$@"
+
+# pass-6 IR + tolerance legs (ISSUE 17): the SL6xx golden bad fixtures
+# trip at their declared severities with clean twins, the shipped
+# numeric contracts (TSQR, hSVD-L0, ring cmatmul, the quantized
+# all-reduce, the kcluster endpoint, the training step) pin
+# numcheck-clean, and every golden plan composes to exactly its
+# quant.tol annotation under the tolerance invariant.
+python -m pytest tests/test_numcheck.py -q "$@"
 
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
   python scripts/lint.py --ir-entry 8
@@ -280,6 +294,43 @@ for topo in 2x4 2x8; do
   echo "redist golden plans @$topo: deterministic + well-formed ($(wc -l < "$plans_a") plans)"
 done
 rm -f "$plans_a" "$plans_b"
+
+# tolerance-budget sweep (ISSUE 17): the standalone check_tolerance
+# entry re-proves the pass-6 dynamic invariant over every dumped golden
+# plan (flat + both tiered topologies) — each plan's end-to-end error
+# bound, recomposed from its recorded per-step tolerances, equals the
+# schedule-level quant.tol annotation — and a hand-malformed tol
+# annotation fails NAMING the tolerance invariant (verify_plans.py
+# gates the same defect; this leg pins the findings-collecting face).
+tol_dump="$(mktemp)"
+python scripts/redist_plans.py > "$tol_dump"
+python scripts/redist_plans.py --topology 2x4 >> "$tol_dump"
+python scripts/redist_plans.py --topology 2x8 >> "$tol_dump"
+python - "$tol_dump" <<'EOF'
+import json, sys
+from heat_tpu.analysis.planverify import check_tolerance
+n = nq = 0
+mutable = None
+for line in open(sys.argv[1]):
+    name, _, payload = line.strip().partition("\t")
+    if not payload:
+        continue
+    findings = check_tolerance(payload)
+    assert not findings, f"{name}: {[str(f) for f in findings]}"
+    n += 1
+    d = json.loads(payload)
+    if d.get("quant"):
+        nq += 1
+        mutable = mutable or d
+assert n and nq, f"swept {n} plans but {nq} quantized"
+mutable["quant"]["tol"] = float(mutable["quant"]["tol"]) * 2
+bad = check_tolerance(mutable)
+assert bad and all(f.rule == "SL605" for f in bad), [str(f) for f in bad]
+assert "tol" in str(bad[0]), str(bad[0])
+print(f"check_tolerance: {n} plan(s) ({nq} quantized) compose to their "
+      "declared budgets; malformed tol names SL605")
+EOF
+rm -f "$tol_dump"
 
 # calibration legs (ISSUE 16): (30) the escape-hatch parity diff —
 # gate unset, gate EMPTY, and a measured profile sitting on disk but

@@ -33,9 +33,22 @@ Comm lint (pass 5) over the same tree::
   ``ht.analysis.check``/``ht.analysis.commcheck``; the plan-side
   ``progress`` invariant rides ``scripts/verify_plans.py``.)
 
+Precision lint (pass 6) over the same tree::
+
+    python scripts/lint.py heat_tpu/ --pass numcheck
+
+  The ``numcheck`` source arm (SL602): every op
+  ``numcheck.PLANAR_PRECISION_POLICY`` marks ``"highest"`` must default
+  its MXU precision to HIGHEST in ``core/complex_planar.py`` — deleting
+  the PR 5 ``precision="highest"`` default is an error here, the
+  mechanized form of the 13% on-chip defect. (The IR rules SL601–SL603
+  ride ``ht.analysis.check``/``ht.analysis.numcheck``, SL604 rides the
+  standalone entry, and the plan-side ``tolerance`` invariant rides
+  ``scripts/verify_plans.py``.)
+
   ``--pass all`` (the default when paths are given) is the single CI
-  lint entry (ISSUE 14): passes 2, 4 and 5 run in ONE process with one
-  SARIF document per run.
+  lint entry (ISSUE 14): passes 2, 4, 5 and 6 run in ONE process with
+  one SARIF document per run.
 
 IR lint (pass 1) over the driver training step::
 
@@ -147,14 +160,15 @@ def main() -> int:
     ap.add_argument(
         "--pass",
         dest="which",
-        choices=("srclint", "effectcheck", "commcheck", "all"),
+        choices=("srclint", "effectcheck", "commcheck", "numcheck", "all"),
         default="all",
         help="which source passes to run over the given paths: pass 2 "
         "(srclint, SL2xx), pass 4 (effectcheck, SL4xx: gate/cache-key "
         "staleness, raw gate reads, lock discipline, pipeline protocol), "
-        "pass 5 (commcheck, SL504: unfenced dispatch entries), or all "
-        "three in ONE process — the single CI lint entry (default; one "
-        "SARIF document with one run per pass)",
+        "pass 5 (commcheck, SL504: unfenced dispatch entries), pass 6 "
+        "(numcheck, SL602: the planar precision policy), or all four in "
+        "ONE process — the single CI lint entry (default; one SARIF "
+        "document with one run per pass)",
     )
     ap.add_argument(
         "--format",
@@ -195,6 +209,14 @@ def main() -> int:
         report = _commcheck_paths(args.paths, root=ROOT)
         _print_report(report, "commcheck", fmt)
         reports.append(("commcheck", report))
+        gate |= not report.ok
+
+    if args.paths and args.which in ("numcheck", "all"):
+        from heat_tpu.analysis.numcheck import lint_paths as _numcheck_paths
+
+        report = _numcheck_paths(args.paths, root=ROOT)
+        _print_report(report, "numcheck", fmt)
+        reports.append(("numcheck", report))
         gate |= not report.ok
 
     if args.ir_entry is not None:

@@ -10,9 +10,17 @@ labels, overlap structure, plan-id integrity, and (ISSUE 14) the
 participant runs the schedule to completion — congruent group
 structure, rings closing in exactly p-1 hops, hierarchical ici/dcn
 lap pairs sharing one chunk, depth-2 lap tags issued in exactly the
-order the double buffer consumes them. A malformed plan fails the leg
-with the violated invariant named (tests/test_commcheck.py proves a
-hand-mutated lap order fails here naming ``progress``)::
+order the double buffer consumes them — and (ISSUE 17) the
+``tolerance`` invariant: the end-to-end error bound recomputed from
+the recorded per-step tolerances (each quantize step contributes the
+codec's pinned ``tolerance(mode)`` to the disjoint payload leg it
+encodes; staging/relayout/overlap steps are exact-bit; hierarchical
+plans charge only dcn-tier legs) must equal the schedule-level
+``quant.tol`` annotation. A malformed plan fails the leg with the
+violated invariant named (tests/test_commcheck.py proves a
+hand-mutated lap order fails here naming ``progress``;
+tests/test_numcheck.py proves ≥6 seeded tolerance mutations fail
+naming ``tolerance`` with the step)::
 
     python scripts/redist_plans.py > plans.txt
     python scripts/verify_plans.py plans.txt

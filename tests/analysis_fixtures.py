@@ -719,3 +719,167 @@ def serving_sync_handler(x):
         peek = jax.device_get(y._phys)  # shardlint: ignore[SL201] -- fixture
         print("serving batch mean:", peek.mean())
     return y + 1.0
+
+
+# --------------------------------------------------------------------- #
+# pass 6 (ISSUE 17): numcheck golden bad fixtures                        #
+# --------------------------------------------------------------------- #
+# Pure-jax programs over jnp arrays (numcheck's calling contract admits
+# them like check's): the wrong-number class is a property of the traced
+# jaxpr's dtypes, not of the DNDarray layer. Each bad fixture has a
+# clean twin one fix away — the fix the finding message names.
+def low_precision_gram_program(x):
+    """SL601: a bf16 gram matrix accumulated IN bf16 — the contraction
+    runs over the full feature extent (>= the acc-dim threshold) and
+    every MXU pass rounds the partial sum to 8 mantissa bits. The fix
+    is ONE argument: ``preferred_element_type=jnp.float32`` (see
+    cluster/_pallas.py's gram builders — accumulate wide, store
+    narrow)."""
+    import jax.numpy as jnp
+
+    return jnp.matmul(x.T, x)  # bf16 @ bf16 -> bf16 accumulator
+
+
+def f32_accum_gram_program(x):
+    """Clean twin of ``low_precision_gram_program``: same bf16 operands,
+    same contraction — the accumulator is f32 via
+    ``preferred_element_type`` (the sanctioned form SL601's message
+    names)."""
+    import jax.numpy as jnp
+
+    return jnp.matmul(x.T, x, preferred_element_type=jnp.float32)
+
+
+def low_precision_reduce_program(x):
+    """SL601 (reduce arm, error extent): a raw bf16 reduce_sum over the
+    whole axis — ``jnp.sum`` would auto-upcast (and is therefore
+    clean), so the bad form binds the primitive the way a custom
+    kernel's reference or a transpose rule would."""
+    import jax
+
+    return jax.lax.reduce_sum_p.bind(x, axes=(0,))
+
+
+def upcast_reduce_program(x):
+    """Clean twin of ``low_precision_reduce_program``: upcast before the
+    sum, narrow after — also exactly what ``jnp.sum(x)`` emits for
+    bf16 input."""
+    import jax.numpy as jnp
+
+    return jnp.sum(x, axis=0).astype(x.dtype)
+
+
+def gauss_default_precision_program(ar, ai, br, bi):
+    """SL602: the planar-complex Gauss 3-multiply form at DEFAULT MXU
+    precision — ``p3 - p1 - p2`` recovers the imaginary part by
+    cancellation of products sharing operands, and default (bf16)
+    passes turn that into up to 13% relative error on chip (the PR 5
+    live defect, re-created)."""
+    import jax.numpy as jnp
+
+    p1 = jnp.matmul(ar, br)
+    p2 = jnp.matmul(ai, bi)
+    p3 = jnp.matmul(ar + ai, br + bi)
+    return p1 - p2, p3 - p1 - p2
+
+
+def gauss_highest_precision_program(ar, ai, br, bi):
+    """Clean twin of ``gauss_default_precision_program``: the same form
+    with every dot stamped ``Precision.HIGHEST`` — exact f32 MXU
+    products, the sanctioned planar lowering (numcheck reports it at
+    info, never gating)."""
+    import jax
+    import jax.numpy as jnp
+
+    hp = jax.lax.Precision.HIGHEST
+    p1 = jnp.matmul(ar, br, precision=hp)
+    p2 = jnp.matmul(ai, bi, precision=hp)
+    p3 = jnp.matmul(ar + ai, br + bi, precision=hp)
+    return p1 - p2, p3 - p1 - p2
+
+
+def gauss_pragma_acknowledged_program(ar, ai, br, bi):
+    """Pragma twin of ``gauss_default_precision_program``: the same
+    cancellation-prone form, acknowledged IN SOURCE — the pragma names
+    the rule and the reason, and numcheck downgrades SL602 to info
+    (recorded, never gating)."""
+    # numcheck: ignore[SL602] -- validated against the f64 reference path
+    import jax.numpy as jnp
+
+    p1 = jnp.matmul(ar, br)
+    p2 = jnp.matmul(ai, bi)
+    p3 = jnp.matmul(ar + ai, br + bi)
+    return p1 - p2, p3 - p1 - p2
+
+
+def bf16_carry_scan_program(x):
+    """SL603 (carry arm): a running mean whose loop carry is CAST to
+    bf16 before the scan — every lap re-rounds the accumulated state
+    to 8 mantissa bits (the KMeans bf16-counts bug, re-created as the
+    scan shape)."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(mean, row):
+        return 0.9 * mean + 0.1 * row.astype(mean.dtype), ()
+
+    mean0 = x[0].astype(jnp.bfloat16)  # f32 state narrowed INTO the loop
+    mean, _ = jax.lax.scan(body, mean0, x)
+    return mean
+
+
+def f32_carry_scan_program(x):
+    """Clean twin of ``bf16_carry_scan_program``: the carry stays f32;
+    only the per-row payload may ride narrow."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(mean, row):
+        return 0.9 * mean + 0.1 * row.astype(jnp.float32), ()
+
+    mean0 = x[0].astype(jnp.float32)
+    mean, _ = jax.lax.scan(body, mean0, x)
+    return mean
+
+
+def bf16_ef_carry_program(carry, grad):
+    """SL603 (cross-program arm): a DP-style error-feedback step that
+    returns its residual carry DOWN-CAST to bf16 — the carry rides the
+    ``ht.jit`` boundary back in next step, and the residual it stores
+    IS the low-order bits the cast throws away (the contract
+    optim/dp_optimizer.py keeps by holding its EF carry in f32)."""
+    import jax.numpy as jnp
+
+    h = grad + carry                      # compensate
+    update = jnp.round(h * 8.0) / 8.0     # coarse quantized apply
+    residual = h - update
+    return update, residual.astype(jnp.bfloat16)  # carry dies here
+
+
+def f32_ef_carry_program(carry, grad):
+    """Clean twin of ``bf16_ef_carry_program``: the residual carry
+    returns in full f32 width."""
+    import jax.numpy as jnp
+
+    h = grad + carry
+    update = jnp.round(h * 8.0) / 8.0
+    return update, h - update
+
+
+def f64_request_program(x):
+    """SL604: requests f64 mid-program. Under the x64-disabled platform
+    policy (core/devices.py — TPU runs x64 off) the astype silently
+    degrades to f32 at trace time: the jaxpr shows float32 everywhere
+    and only the source scan can see the unmet request."""
+    import jax.numpy as jnp
+
+    return jnp.cumsum(x.astype(jnp.float64))
+
+
+def f32_request_program(x):
+    """Clean twin of ``f64_request_program``: requests the f32 the
+    platform actually provides — the narrowing is visible in the
+    source."""
+    import jax.numpy as jnp
+
+    return jnp.cumsum(x.astype(jnp.float32))

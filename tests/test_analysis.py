@@ -494,11 +494,16 @@ class TestLintCLI(TestCase):
         doc = json.loads(ok.stdout)
         self.assertEqual(doc["version"], "2.1.0")
         # one run per pass — the default `--pass all` is the single CI
-        # lint entry (ISSUE 14): passes 2, 4 AND 5 in one process, one
-        # SARIF document with one run per pass
+        # lint entry (ISSUE 14; ISSUE 17 adds pass 6): passes 2, 4, 5
+        # AND 6 in one process, one SARIF document with one run per pass
         self.assertEqual(
             [run["tool"]["driver"]["name"] for run in doc["runs"]],
-            ["shardlint/srclint", "shardlint/effectcheck", "shardlint/commcheck"],
+            [
+                "shardlint/srclint",
+                "shardlint/effectcheck",
+                "shardlint/commcheck",
+                "shardlint/numcheck",
+            ],
         )
         import tempfile
 
@@ -618,6 +623,42 @@ class TestBenchCompareNewRows(TestCase):
         res = bc.compare(current, baseline, 0.10)
         self.assertEqual(res["verdict"], "regressed")
         self.assertEqual(res["new_rows"], ["brand_new"])
+
+    def test_measurement_suspect_rows_waived_but_counted(self):
+        """ISSUE 17 satellite: a regression on a row either side flags
+        ``measurement_suspect`` never gates (the r5 attention-MFU
+        0.68->0.58 slip was exactly this shape) — but it stays in the
+        record, marked waived and counted in the summary."""
+        bc = self._mod()
+        current = {
+            "detail": {
+                "attn": {"mfu": 0.58, "measurement_suspect": True},
+                "solid": {"gbps": 10.0},
+            }
+        }
+        baseline = {"key_rows": {"attn": {"mfu": 0.68}, "solid": {"gbps": 10.0}}}
+        res = bc.compare(current, baseline, 0.10)
+        self.assertEqual(res["verdict"], "ok")
+        self.assertEqual(res["waived"], 1)
+        self.assertEqual(len(res["regressions"]), 1)
+        self.assertEqual(res["regressions"][0]["row"], "attn")
+        self.assertEqual(res["regressions"][0]["waived"], "measurement_suspect")
+        # the suspect flag on the BASELINE side waives too
+        res2 = bc.compare(
+            {"detail": {"attn": {"mfu": 0.58}}},
+            {"key_rows": {"attn": {"mfu": 0.68, "measurement_suspect": True}}},
+            0.10,
+        )
+        self.assertEqual(res2["verdict"], "ok")
+        self.assertEqual(res2["waived"], 1)
+        # an unflagged regression of the same size still gates
+        res3 = bc.compare(
+            {"detail": {"attn": {"mfu": 0.58}}},
+            {"key_rows": {"attn": {"mfu": 0.68}}},
+            0.10,
+        )
+        self.assertEqual(res3["verdict"], "regressed")
+        self.assertEqual(res3["waived"], 0)
 
 
 if __name__ == "__main__":

@@ -144,16 +144,19 @@ class TestGateRegistry(TestCase):
     def test_scope_and_roster_derivations(self):
         affecting = {s.name for s in gates.affecting_programs()}
         # the serving/telemetry/tracing switches change no program
-        # bytes, and neither does the checkpoint store path (ISSUE 13);
-        # the resilience runtime switch IS roster material (its
-        # registration version-bumps pre-resilience AOT envelopes)
+        # bytes, and neither does the checkpoint store path (ISSUE 13)
+        # or the numcheck analyzer threshold (ISSUE 17 — read-only
+        # report tuning); the resilience runtime switch IS roster
+        # material (its registration version-bumps pre-resilience AOT
+        # envelopes)
         self.assertNotIn("HEAT_TPU_SERVING_AOT", affecting)
         self.assertNotIn("HEAT_TPU_SERVING_CACHE", affecting)
         self.assertNotIn("HEAT_TPU_TELEMETRY", affecting)
         self.assertNotIn("HEAT_TPU_CKPT_DIR", affecting)
         self.assertNotIn("HEAT_TPU_TRACE", affecting)
+        self.assertNotIn("HEAT_TPU_NUMCHECK_ACC_DIM", affecting)
         self.assertIn("HEAT_TPU_RESILIENCE", affecting)
-        self.assertEqual(len(affecting), len(gates.GATES) - 5)
+        self.assertEqual(len(affecting), len(gates.GATES) - 6)
         self.assertEqual(
             gates.program_gate_roster(), ",".join(sorted(affecting))
         )
