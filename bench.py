@@ -114,6 +114,15 @@ CHAIN_N = 67_108_865                           # 256 MB/pass; odd length exercis
 KM_BIG_N = 15_625_000                          # KMeans north-star per-chip shard:
                                                # 1B x 64 over v5e-64 = 15.625M rows
                                                # (~4 GB f32) per chip (BASELINE #4)
+SPMM_N = 16384                                 # spmm_1gb: 1 GB dense-EQUIVALENT
+                                               # operand (16384^2 f32); the brick
+                                               # engine stores/streams 67 MB of it
+SPMM_OCC = 0.0625                              # brick-grid fill: 16384 full (8,128)
+                                               # bricks -> 16.7M nnz
+SPMM_K = 4                                     # slim dense operand (embedding-ish)
+PR_N, PR_DEG = 8192, 256                       # pagerank_2m: ~2M edges after
+                                               # self-loop drop + dedup
+PR_TOL = 1e-8
 
 
 def _best_of(fn, reps: int = 3) -> float:
@@ -1306,6 +1315,145 @@ def measure_heat_tpu() -> dict:
         }
     del srtb
 
+    # spmm_1gb (ISSUE 18): brick-CSR SpMM over a 1 GB dense-EQUIVALENT
+    # operand (16384^2 f32) at 6.25% brick-grid fill — every stored
+    # brick is a full (8,128) VREG tile, so the engine streams 67 MB
+    # where the dense matmul twin streams the whole gigabyte. The twin
+    # runs interleaved in the same rep loop so `vs_dense_matmul` is a
+    # same-run ratio (the vs_jnp_sort discipline). The floor is the
+    # lattice's nnz-weighted wire mass (tiers.sparse_transfer_time:
+    # value + int32 column index per stored element, once per pass).
+    import scipy.sparse as _scipy_sp
+    from heat_tpu.core import tiers as _tiers
+    from heat_tpu.kernels import spmm as _kspmm
+    from heat_tpu.observability import calibration as _calibration
+    from heat_tpu.sparse.dbcsr_matrix import BRICK_SHAPE as _BRICK
+
+    _br, _bc = _BRICK
+    _smb, _snb = SPMM_N // _br, SPMM_N // _bc
+    _srng = np.random.default_rng(0x18)
+    _lin = np.sort(_srng.choice(_smb * _snb, int(_smb * _snb * SPMM_OCC), replace=False))
+    _sbrow = (_lin // _snb).astype(np.int32)
+    _sbindptr = np.zeros(_smb + 1, np.int64)
+    np.add.at(_sbindptr, _sbrow + 1, 1)
+    _sbsr = _scipy_sp.bsr_matrix(
+        (
+            _srng.standard_normal((_lin.size, _br, _bc)).astype(np.float32),
+            (_lin % _snb).astype(np.int32),
+            np.cumsum(_sbindptr),
+        ),
+        shape=(SPMM_N, SPMM_N),
+    )
+    Ssp = ht.sparse.sparse_dbcsr_matrix(_sbsr, split=0)
+    Dsp = jnp.asarray(_sbsr.toarray())  # the dense twin's 1 GB operand
+    del _sbsr
+    xsp = ht.random.randn(SPMM_N, SPMM_K, split=None)._phys
+
+    # this deployment's stream price: the lattice hbm edge on TPU or
+    # under an active calibration profile; otherwise the live PR 16
+    # copy probe — on the CPU container the 819 GB/s constant would
+    # price a fiction and fabricate a ~0.005 nnz_bw_frac
+    stream_source = "lattice"
+    stream_bps = _tiers.bandwidth("hbm")
+    if jax.default_backend() != "tpu" and _tiers.profile_id() is None:
+        _hbm_probe = _calibration.probe_hbm()
+        if _hbm_probe and not _hbm_probe.get("measurement_suspect"):
+            stream_bps, stream_source = _hbm_probe["bps"], "copy-probe"
+
+    _sB = Ssp.slab_bricks
+    _spath = _kspmm.decide("spmm", _sB, SPMM_K, "float32")
+    _sprog = _kspmm.spmm_bcsr_program(
+        Ssp.comm, SPMM_N, Ssp.nb, _sB, Ssp.split, 2, "float32", _spath
+    )
+
+    # both loops feed y (n, k) back as the next operand — the data
+    # dependency defeats dead-compute elimination, and SPMM_N square
+    # makes the shapes close
+    @functools.lru_cache(maxsize=None)
+    def _spmm_loop(k):
+        def run(bdata, bcol, brow, bmask, xv):
+            return lax.fori_loop(
+                0, k, lambda i, y: _sprog(bdata, bcol, brow, bmask, y), xv
+            )
+        return jax.jit(run)
+
+    @functools.lru_cache(maxsize=None)
+    def _spmm_dense_loop(k):
+        def run(d, xv):
+            return lax.fori_loop(0, k, lambda i, y: d @ y, xv)
+        return jax.jit(run)
+
+    spmm_wire = Ssp.nnz * (4 + 4)  # the sparse_transfer_time mass
+    spmm_floors = {
+        "sp": spmm_wire / n_dev / stream_bps,
+        "dn": (SPMM_N * SPMM_N + 2 * SPMM_N * SPMM_K) * 4 / stream_bps,
+    }
+    sgrp = _measure_bounded_group(
+        lambda: _loop_program_group(
+            {
+                "sp": (_spmm_loop, (*Ssp._phys_components, xsp)),
+                "dn": (_spmm_dense_loop, (Dsp, xsp)),
+            },
+            sync, k1=2, k2=10,
+        ),
+        spmm_floors,
+    )
+    out["spmm_1gb"], out["dense_matmul_1gb"] = sgrp["sp"], sgrp["dn"]
+    _progress("spmm_1gb", out["spmm_1gb"])
+    _progress("dense_matmul_1gb", out["dense_matmul_1gb"])
+    method["spmm_1gb"] = method["dense_matmul_1gb"] = "loop-program (interleaved pair)"
+    out["_spmm_meta"] = {
+        "nnz": int(Ssp.nnz),
+        "occupancy": round(Ssp.occupancy, 4),
+        "bricks": int(Ssp.nbricks),
+        "wire_bytes": int(spmm_wire),
+        "path": _spath,
+        "kernel_mode": _kspmm.spmm_kernel_mode(),
+        "stream_gbps": round(stream_bps / 1e9, 2),
+        "stream_source": stream_source,
+        "gbps": round(spmm_wire / out["spmm_1gb"] / 1e9, 2),
+        # achieved fraction of the nnz-bandwidth floor — the ISSUE 18
+        # acceptance pin (>= 0.5 on the CPU container)
+        "nnz_bw_frac": round(
+            spmm_wire / n_dev / stream_bps / out["spmm_1gb"], 3
+        ),
+        "vs_dense_matmul": round(out["dense_matmul_1gb"] / out["spmm_1gb"], 3),
+    }
+    del Ssp, Dsp, xsp
+
+    # pagerank_2m (ISSUE 18): the end-to-end graph scenario — PageRank
+    # on a seeded ~2M-edge random digraph through the public API, so the
+    # wall-clock includes the host-side transition build, the DBCSR
+    # landing, and one brick-engine SpMV per fixpoint iteration.
+    # iterations-to-tol is deterministic for the seeded graph; edges/s
+    # counts every edge of every sweep.
+    from heat_tpu.graph import pagerank as _pagerank
+
+    _prng = np.random.default_rng(0x18)
+    _psrc = _prng.integers(0, PR_N, PR_N * PR_DEG)
+    _pdst = _prng.integers(0, PR_N, PR_N * PR_DEG)
+    _pkeep = _psrc != _pdst
+    _prA = _scipy_sp.csr_matrix(
+        (
+            np.ones(int(_pkeep.sum()), np.float32),
+            (_psrc[_pkeep], _pdst[_pkeep]),
+        ),
+        shape=(PR_N, PR_N),
+    )
+    _prA.sum_duplicates()
+    _pres = _pagerank(_prA, tol=PR_TOL)  # warm: autotune + program cache
+    out["pagerank_2m"] = _best_of(lambda: _pagerank(_prA, tol=PR_TOL), reps=2)
+    _progress("pagerank_2m", out["pagerank_2m"])
+    method["pagerank_2m"] = "eager wall-clock best-of (full fixpoint, conversion included)"
+    out["_pagerank_meta"] = {
+        "edges": int(_prA.nnz),
+        "iterations": int(_pres.iterations),
+        "converged": bool(_pres.converged),
+        "tol": PR_TOL,
+        "edges_per_s": int(_prA.nnz * _pres.iterations / out["pagerank_2m"]),
+    }
+    del _prA
+
     # op-dispatch overhead: a chained elementwise expression through the
     # ht.* wrappers vs the same 3 eager jnp dispatches vs ONE hand-jitted
     # fused program — all three feed their output back in (values run to
@@ -1984,6 +2132,21 @@ def main() -> None:
         if ratio is not None:
             detail[row]["vs_sequential"] = round(ratio, 3)
 
+    # sparse-engine rows (ISSUE 18): fold the measured-alongside
+    # metadata into the gated rows — the nnz-bandwidth fraction and
+    # dense-twin ratio for spmm_1gb, the fixpoint census for
+    # pagerank_2m. A fraction past 1.0 means the sample beat its own
+    # wire mass (weather); an unconverged fixpoint means the seconds
+    # measured a truncated run, not the scenario.
+    if "spmm_1gb" in detail:
+        detail["spmm_1gb"].update(ours.get("_spmm_meta", {}))
+        if detail["spmm_1gb"].get("nnz_bw_frac", 0) > 1.0:
+            detail["spmm_1gb"]["measurement_suspect"] = True
+    if "pagerank_2m" in detail:
+        detail["pagerank_2m"].update(ours.get("_pagerank_meta", {}))
+        if not detail["pagerank_2m"].get("converged", True):
+            detail["pagerank_2m"]["measurement_suspect"] = True
+
     # dp_step_quant (ISSUE 7): the analytic v5e-64 quantized-gradient
     # row — no DP mesh is attached, so the row IS the checkable model
     # (the MULTICHIP_*.json convention): a 100M-param f32 ICI-bound
@@ -2310,6 +2473,19 @@ def main() -> None:
                 if "kmeans_iter_4gb" in detail else {}
             ),
             "sort_1gb": pick("sort_1gb", "melem_per_s", "vs_jnp_sort", "sort_frac", "path"),
+            # ISSUE 18 sparse-engine rows: the nnz-bandwidth fraction
+            # (acceptance floor >= 0.5 on the CPU container), the
+            # same-run dense-twin ratio + dispatched path, and the
+            # PageRank scenario's iterations-to-tol and edge rate —
+            # gated by scripts/bench_compare.py
+            "spmm_1gb": pick(
+                "spmm_1gb", "gbps", "nnz_bw_frac", "vs_dense_matmul",
+                "path", "measurement_suspect",
+            ),
+            "pagerank_2m": pick(
+                "pagerank_2m", "iterations", "edges_per_s",
+                "measurement_suspect",
+            ),
             # the ROADMAP reshape acceptance fields (ISSUE 5) + the
             # ISSUE 6 overlap fields (`critical_path_model` = modeled
             # max-vs-sum speedup, `vs_sequential` = measured same-run
@@ -2402,7 +2578,10 @@ def main() -> None:
         "detail_file": "BENCH_DETAIL.json",
     }
     line = json.dumps(compact)
-    assert len(line) < 1500, f"compact bench line too long ({len(line)} chars)"
+    # 1700: headroom under the driver's ~2000-char tail capture once the
+    # ISSUE 18 sparse rows joined the key set (BENCH_r03 proved what a
+    # mid-JSON truncation costs — parsed:null for the whole round)
+    assert len(line) < 1700, f"compact bench line too long ({len(line)} chars)"
     print(line)
 
 

@@ -78,6 +78,36 @@ HOST_FUNCS: Dict[Tuple[str, str], str] = {
     ("sparse/dcsr_matrix.py", "DCSR_matrix.__repr__"): (
         "debug rendering of the CSR triple on the host"
     ),
+    ("sparse/dbcsr_matrix.py", "DBCSR_matrix._to_scipy_bsr"): (
+        "export: reassembles the global scipy BSR on the host (the "
+        "brick analog of DNDarray.__host_logical — every .to_scipy()/"
+        "oracle comparison funnels through it)"
+    ),
+    ("sparse/dbcsr_matrix.py", "sparse_dbcsr_matrix"): (
+        "ingestion factory: normalizes arbitrary host/device/DCSR input "
+        "to slab-laid bricks at construction time (the sparse analog of "
+        "complex_planar.array_factory — eager by definition)"
+    ),
+    ("graph/pagerank.py", "_adjacency_to_scipy"): (
+        "ingestion: normalizes any adjacency form (DBCSR/DCSR/DNDarray/"
+        "host) to a host scipy CSR once at solve setup — the graph "
+        "solvers build their brick operator from the host copy"
+    ),
+    ("preprocessing/sparse_encoders.py", "TfidfTransformer._counts_csr"): (
+        "ingestion: normalizes fit() input to a host scipy CSR of term "
+        "counts — document-frequency statistics are host-side by "
+        "contract (fit is the eager estimation phase)"
+    ),
+    ("preprocessing/sparse_encoders.py", "OneHotEncoder.stream_transform"): (
+        "slab-streamed transform whose contract is a HOST result: each "
+        "window's encoded block is written back into the host output "
+        "buffer (stage_out of the staging schedule it proves first)"
+    ),
+    ("preprocessing/sparse_encoders.py", "TfidfTransformer.stream_transform"): (
+        "slab-streamed transform whose contract is a HOST result: the "
+        "reweighted window lands in the host output buffer (stage_out "
+        "of the proven staging schedule)"
+    ),
 }
 
 # (path suffix, qualname) -> reason. Eager-only data-dependent-shape ops.
@@ -157,6 +187,25 @@ HOST_BOUNDARIES: Dict[str, Tuple[str, str, str]] = {
         "read-back is the completion fence per timed probe. Runs only "
         "eagerly on TPU at executor program-BUILD time, never inside a "
         "trace",
+    ),
+    "pagerank-stream-fixpoint": (
+        "graph/pagerank.py",
+        "pagerank_stream",
+        "the streamed power iteration keeps the rank vector "
+        "HOST-resident between slab-window sweeps (the edge list never "
+        "fits on device — that is the point of the streamed form): one "
+        "(n,)-vector readback per sweep funds the exact dangling-mass "
+        "correction and the full-vector l1 convergence test; edge slabs "
+        "themselves never round-trip",
+    ),
+    "spectral-ritz-extract": (
+        "graph/spectral.py",
+        "spectral_embedding",
+        "Ritz extraction: the (m,) Lanczos alpha/beta coefficients are "
+        "read to the host ONCE to assemble and eigh the m-by-m "
+        "tridiagonal — an O(m^2) host solve against the O(n*m) device "
+        "sweep; only scalar-class vectors cross, the Krylov basis stays "
+        "on device for the final V @ W",
     ),
 }
 

@@ -417,10 +417,23 @@ def _input_facts(fn, args, kwargs, traced_in, mesh_size: int) -> List[_Fact]:
     from ..core.dndarray import DNDarray
     from ..core.jit import _is_leaf
 
+    from ..sparse.dbcsr_matrix import DBCSR_matrix
+    from ..sparse.dcsr_matrix import DCSR_matrix
+
     leaves, _ = jax.tree.flatten((args, kwargs), is_leaf=_is_leaf)
     facts = []
     for leaf in leaves:
-        if isinstance(leaf, DNDarray):
+        if isinstance(leaf, (DCSR_matrix, DBCSR_matrix)):
+            # sparse operands price by their ACTUAL nnz-padded component
+            # bytes (data + indices + metadata), never the dense shape —
+            # a 1%-occupancy matrix would otherwise fail admission 100x
+            # too early
+            gb = int(leaf.component_nbytes)
+            if leaf.split is None or leaf.comm.size <= 1:
+                facts.append(_Fact(gb, leaf.comm.size > 1))
+            else:
+                facts.append(_Fact(gb // max(leaf.comm.size, 1), False))
+        elif isinstance(leaf, DNDarray):
             phys = leaf._phys
             gb = int(np.prod(phys.shape, dtype=np.int64)) * np.dtype(phys.dtype).itemsize
             if leaf.split is None or leaf.comm.size <= 1:

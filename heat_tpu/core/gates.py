@@ -207,6 +207,15 @@ declare(GateSpec(
          "1 = force the Pallas tiled copy, auto = TPU autotune",
 ))
 declare(GateSpec(
+    "HEAT_TPU_SPMM_KERNEL", default="auto", values=("0", "1", "auto"),
+    affects_programs=True, scopes=("program", "aot"),
+    key_params=("impl", "path"),
+    accessors=("spmm_kernel_mode",),
+    help="block-sparse SpMM/SDDMM dispatch: 0 = gather-free XLA "
+         "segment-sum oracle, 1 = force the Pallas brick kernel "
+         "(interpret mode off-TPU), auto = TPU autotune",
+))
+declare(GateSpec(
     "HEAT_TPU_REDIST_PLANNER", default="1", values=("0", "1"),
     affects_programs=True, scopes=("program", "aot"),
     key_params=(),

@@ -92,13 +92,23 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
 
 
 @functools.lru_cache(maxsize=64)
-def _lanczos_program(n: int, m: int, jdtype: str, breakdown_tol: float):
+def _lanczos_program(n: int, m: int, jdtype: str, breakdown_tol: float,
+                     matvec=None):
     """One jitted Lanczos run: scan over the m steps; each step does the
     matvec, masked full reorthogonalization against the basis so far
     (reference solver.py:245-255 Gram-Schmidts every new vector), and a
     ``lax.cond``-free invariant-subspace restart via a select on a fresh
-    random direction (reference draws a random vector on breakdown)."""
+    random direction (reference draws a random vector on breakdown).
+
+    ``matvec`` generalizes the operator: ``None`` keeps the dense
+    ``A @ v`` (trace-identical to before the parameter existed — the
+    default program is byte-for-byte the same); otherwise ``A`` may be
+    any jit-flattenable pytree of operator components and each step
+    applies ``matvec(A, v)`` (graph/spectral.py passes the DBCSR
+    Laplacian this way). Callables hash by identity, so callers must
+    pass a cached/module-level function, not a fresh lambda per call."""
     tol = breakdown_tol
+    mv = (lambda A, x: A @ x) if matvec is None else matvec
 
     # inner products are CONJUGATED (x^H y) so the same program is the
     # hermitian-Lanczos on native complex inputs (CPU/GPU worlds); on
@@ -107,7 +117,7 @@ def _lanczos_program(n: int, m: int, jdtype: str, breakdown_tol: float):
     # must not promote through a complex dtype.
     def run(A, v0, key):
         V0 = jnp.zeros((n, m), dtype=jdtype).at[:, 0].set(v0)
-        w0 = A @ v0
+        w0 = mv(A, v0)
         a0 = jnp.conj(v0) @ w0
         w0 = w0 - a0 * v0
         alpha0 = jnp.zeros((m,), dtype=jdtype).at[0].set(a0)
@@ -125,7 +135,7 @@ def _lanczos_program(n: int, m: int, jdtype: str, breakdown_tol: float):
             vi = vi - V @ proj
             vi = vi / jnp.sqrt((jnp.conj(vi) @ vi).real).astype(jdtype)
             V = lax.dynamic_update_slice_in_dim(V, vi[:, None], i, axis=1)
-            w = A @ vi
+            w = mv(A, vi)
             a_i = jnp.conj(vi) @ w
             v_prev = lax.dynamic_slice_in_dim(V, i - 1, 1, axis=1)[:, 0]
             w = w - a_i * vi - b_i.astype(jdtype) * v_prev

@@ -277,6 +277,19 @@ def transfer_time(nbytes: int, edge: str) -> float:
     return max(int(nbytes), 0) / bandwidth(edge)
 
 
+def sparse_transfer_time(nnz: int, itemsize: int, edge: str) -> float:
+    """Seconds to move a sparse operand of ``nnz`` stored elements
+    across ``edge``: each element ships its value (``itemsize`` bytes)
+    plus its int32 column index, the CSR/BCSR wire mass that actually
+    crosses a lattice edge (the indptr/brick-row metadata is O(rows)
+    and amortizes to nothing at any nnz worth pricing). The nnz-weighted
+    twin of :func:`transfer_time` the planner and memcheck use when a
+    DCSR/DBCSR operand crosses an edge — pricing the DENSE shape
+    instead would overstate a 1%%-occupancy operand by 100x and break
+    serving admission."""
+    return transfer_time(max(int(nnz), 0) * (int(itemsize) + 4), edge)
+
+
 def penalty(edge: str) -> int:
     """Integer cost-model penalty of one ``edge`` byte relative to one
     ICI byte (= ``ICI_BPS / bandwidth(edge)``, floored, min 1) — the

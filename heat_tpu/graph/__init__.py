@@ -1,3 +1,12 @@
-"""Distributed graph algorithms (reference: /root/reference/heat/graph/)."""
+"""Distributed graph algorithms (reference: /root/reference/heat/graph/).
+
+``Laplacian`` is the reference-parity similarity-graph Laplacian; the
+rest EXCEEDS the reference — sparse-engine analytics on the mesh:
+PageRank as a streamed SpMV fixpoint (:func:`pagerank`, and
+:func:`pagerank_stream` for host-resident edge lists riding the staging
+windows) and :func:`spectral_embedding` feeding the DBCSR brick
+operator to the Lanczos solver."""
 
 from .laplacian import *
+from .pagerank import PageRankResult, pagerank, pagerank_stream
+from .spectral import spectral_embedding

@@ -1,0 +1,141 @@
+"""Spectral embedding: the DBCSR operator fed to the Lanczos solver.
+
+The classical pipeline — symmetrically-normalized graph Laplacian,
+smallest-k eigenvectors, rows as coordinates — but the operator is
+never densified: each Lanczos step's matvec is the brick-sparse
+
+    L_sym v  =  v  -  D^{-1/2} A D^{-1/2} v
+
+evaluated on the DBCSR components inside the solver's jitted scan
+(``core.linalg.solver._lanczos_program`` grew a ``matvec`` parameter
+for exactly this; with it unset the dense program is trace-identical
+to before). The brick contraction uses the same einsum/segment-sum
+formulation as the kernel oracle, with the bmask routing non-owned
+boundary-brick rows to a dropped sentinel segment, so straddle
+duplication never double-counts.
+
+Degrees come from one engine SpMV (``A @ 1``); the small tridiagonal
+eigenproblem solves host-side (``numpy.linalg.eigh`` on an (m, m)
+matrix is microseconds); the embedding ``V @ W_k`` stays on device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import factories, types
+from ..core.dndarray import DNDarray
+from ..sparse.dbcsr_matrix import BRICK_SHAPE, DBCSR_matrix, to_dbcsr
+
+__all__ = ["spectral_embedding"]
+
+_BR, _BC = BRICK_SHAPE
+
+
+@functools.lru_cache(maxsize=64)
+def _lap_matvec(n: int, mb: int, nb: int, normalized: bool):
+    """The Laplacian matvec the Lanczos scan calls — cached so the
+    callable's identity is stable across calls with the same geometry
+    (``_lanczos_program`` keys its program cache on it).
+
+    ``ops = (bdata, bcol, brow, bmask, dvec)``: the DBCSR physical
+    components plus ``dvec = D^{-1/2}`` (normalized) or ``D``
+    (simple). Global formulation: every stored brick contributes
+    ``brick @ x[bcol]`` rows at ``brow * 8 + lane``, the bmask sends
+    rows a device does not own (straddle duplicates, slab pad) to the
+    ``mb * 8`` sentinel segment, and one segment-sum assembles the
+    product."""
+
+    def mv(ops, v):
+        bdata, bcol, brow, bmask, dvec = ops
+        x = v * dvec if normalized else v
+        xp = jnp.pad(x, (0, nb * _BC - n))
+        xg = xp.reshape(nb, _BC)[bcol]                      # (B, 128)
+        contrib = jnp.einsum("bij,bj->bi", bdata, xg)       # (B, 8)
+        rows = (
+            brow[:, None].astype(jnp.int32) * _BR
+            + jnp.arange(_BR, dtype=jnp.int32)[None, :]
+        )
+        rows = jnp.where(bmask, rows, mb * _BR)
+        Av = jax.ops.segment_sum(
+            contrib.reshape(-1), rows.reshape(-1), num_segments=mb * _BR + 1
+        )[:n]
+        if normalized:
+            return v - Av * dvec       # L_sym v = v - D^-1/2 A D^-1/2 v
+        return dvec * v - Av           # L v = D v - A v
+
+    return mv
+
+
+def spectral_embedding(
+    A: Union[DBCSR_matrix, "object"],
+    k: int,
+    m: Optional[int] = None,
+    normalized: bool = True,
+) -> Tuple[np.ndarray, DNDarray]:
+    """Smallest-``k`` spectral coordinates of a symmetric graph.
+
+    ``A`` is a symmetric adjacency (``DBCSR_matrix`` or anything
+    :func:`~heat_tpu.sparse.to_dbcsr` accepts); ``m`` is the Lanczos
+    subspace size (default ``min(n, max(2k + 1, 20))``). Returns
+    ``(eigenvalues, embedding)``: the ``k`` Ritz values closest to the
+    bottom of the Laplacian spectrum and the (n, k) coordinate matrix,
+    distributed like ``A``.
+    """
+    from ..core.linalg import solver as _solver
+
+    if not isinstance(A, DBCSR_matrix):
+        A = to_dbcsr(A)
+    n_rows, n_cols = A.shape
+    if n_rows != n_cols:
+        raise ValueError(f"adjacency must be square, got {A.shape}")
+    n = n_rows
+    k = int(k)
+    if not 1 <= k <= n:
+        raise ValueError(f"k must be in [1, {n}], got {k}")
+    m = int(min(n, max(2 * k + 1, 20)) if m is None else m)
+    if not k <= m <= n:
+        raise ValueError(f"need k <= m <= n, got m={m}")
+
+    Af = A if A.dtype == types.float32 else A.astype(types.float32)
+    # degrees via one engine SpMV; the Laplacian then never materializes
+    deg = np.asarray((Af @ np.ones(n, np.float32)).numpy())
+    if normalized:
+        dvec = jnp.asarray(
+            np.where(deg > 0, 1.0 / np.sqrt(np.maximum(deg, 1e-30)), 0.0)
+            .astype(np.float32)
+        )
+    else:
+        dvec = jnp.asarray(deg.astype(np.float32))
+
+    bdata, bcol, brow, bmask = Af._phys_components
+    ops = (bdata, bcol, brow, bmask, dvec)
+    mv = _lap_matvec(n, Af.mb, Af.nb, bool(normalized))
+
+    rng = np.random.default_rng(0x5BED)
+    v0 = rng.standard_normal(n).astype(np.float32)
+    v0 = jnp.asarray(v0 / np.linalg.norm(v0))
+
+    prog = _solver._lanczos_program(n, m, "float32", 1e-10, mv)
+    key = jax.random.key(0x1A2C05)
+    V_arr, alpha_d, beta_d = prog(ops, v0, key)
+
+    alpha = np.asarray(jax.device_get(alpha_d))
+    beta = np.asarray(jax.device_get(beta_d))
+    T = np.diag(alpha) + np.diag(beta[1:], 1) + np.diag(beta[1:], -1)
+    evals, evecs = np.linalg.eigh(T)      # ascending — smallest first
+    W = jnp.asarray(evecs[:, :k].astype(np.float32))
+    emb = V_arr @ W                        # (n, k) on device
+
+    comm = Af.comm
+    split = 0 if Af.split == 0 else None
+    phys = comm.shard(emb, split) if split == 0 else emb
+    embedding = DNDarray(phys, (n, k), types.float32, split, Af.device, comm)
+    return evals[:k].astype(np.float32), embedding

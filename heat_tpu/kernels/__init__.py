@@ -20,6 +20,7 @@ from . import cmatmul
 from . import quant
 from . import relayout
 from . import sort
+from . import spmm
 from .cmatmul import (
     ring_all_gather,
     ring_matmul_reduce,
@@ -47,6 +48,7 @@ __all__ = [
     "quant",
     "relayout",
     "sort",
+    "spmm",
     "block_sort",
     "decode_blocks",
     "encode_blocks",

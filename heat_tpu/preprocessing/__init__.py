@@ -1,3 +1,8 @@
-"""Data preprocessing (reference: /root/reference/heat/preprocessing/)."""
+"""Data preprocessing (reference: /root/reference/heat/preprocessing/).
+
+``preprocessing`` holds the reference-parity scalers; ``sparse_encoders``
+EXCEEDS the reference with one-hot and TF-IDF transforms that emit
+``DCSR_matrix`` outputs and register as serving ``transform`` endpoints."""
 
 from .preprocessing import *
+from .sparse_encoders import OneHotEncoder, TfidfTransformer

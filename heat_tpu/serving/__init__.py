@@ -52,7 +52,13 @@ from .aot_cache import (
     enabled,
     ensure_program,
 )
-from .dispatcher import Dispatcher, Endpoint, estimator_endpoint, program_endpoint
+from .dispatcher import (
+    Dispatcher,
+    Endpoint,
+    estimator_endpoint,
+    program_endpoint,
+    transform_endpoint,
+)
 
 __all__ = [
     "AOTStore",
@@ -68,6 +74,7 @@ __all__ = [
     "ensure_program",
     "estimator_endpoint",
     "program_endpoint",
+    "transform_endpoint",
     "warmup",
 ]
 

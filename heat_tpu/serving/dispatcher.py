@@ -659,3 +659,15 @@ def estimator_endpoint(estimator, buckets: Sequence[int] = (8, 32, 128),
         input_sharding=input_sharding, donate=donate,
         name=name or spec.get("name", type(estimator).__name__.lower()),
     )
+
+
+def transform_endpoint(transformer, buckets: Sequence[int] = (8, 32, 128),
+                       donate: bool = False, name: Optional[str] = None) -> Endpoint:
+    """An :class:`Endpoint` over a fitted transformer's serving program
+    (one-hot / TF-IDF — ``preprocessing.sparse_encoders``). Same
+    ``serving_program()`` contract as :func:`estimator_endpoint`; split
+    out so warmup manifests and dashboards can tell ``transform``
+    endpoints (feature pipelines) from ``predict`` endpoints (models),
+    and so transformers without a distributed mesh stay replicated."""
+    return estimator_endpoint(transformer, buckets=buckets, donate=donate,
+                              name=name)

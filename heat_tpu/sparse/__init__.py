@@ -2,19 +2,27 @@
 
 Parity with /root/reference/heat/sparse/__init__.py: ``DCSR_matrix``,
 ``sparse_csr_matrix``, ``sparse_add``/``sparse_mul``, ``to_dense``/
-``to_sparse``. ``matmul`` (SpMV/SpMM) EXCEEDS the reference, whose
-sparse type has no multiplication."""
+``to_sparse``. The rest EXCEEDS the reference, whose sparse type has no
+multiplication: ``matmul`` (SpMV/SpMM), the TPU-native block-CSR format
+``DBCSR_matrix`` with fixed (8, 128) VREG bricks
+(``sparse_dbcsr_matrix``/``to_dbcsr``), and ``sddmm`` on the brick
+format (pattern-preserving sampled dense-dense matmul)."""
 
 from .dcsr_matrix import DCSR_matrix
+from .dbcsr_matrix import BRICK_SHAPE, DBCSR_matrix, sparse_dbcsr_matrix, to_dbcsr
 from .factories import sparse_csr_matrix
 from .arithmetics import add, mul
 from .arithmetics import add as sparse_add, mul as sparse_mul
 from .manipulations import to_dense, to_sparse
-from .linalg import matmul
+from .linalg import matmul, sddmm
 
 __all__ = [
+    "BRICK_SHAPE",
+    "DBCSR_matrix",
     "DCSR_matrix",
     "sparse_csr_matrix",
+    "sparse_dbcsr_matrix",
+    "to_dbcsr",
     "add",
     "mul",
     "sparse_add",
@@ -22,4 +30,5 @@ __all__ = [
     "to_dense",
     "to_sparse",
     "matmul",
+    "sddmm",
 ]

@@ -1,0 +1,427 @@
+"""Distributed block-CSR matrix with TPU-native (8, 128) bricks.
+
+``DCSR_matrix`` (dcsr_matrix.py) is the API-parity format: scalar nnz
+entries sharded evenly over the mesh, SpMV by per-element gather +
+segment-sum. That layout is register-hostile on a TPU — every stored
+element turns into a 4-byte gather and a 1-lane FLOP. ``DBCSR_matrix``
+is the compute format: the stored unit is a full **(8, 128) brick** —
+one f32 VREG tile (8 sublanes x 128 lanes), the same quantum the MXU
+and VPU consume — so SpMM runs as dense (8,128)x(128,k) brick matmuls
+with zero layout waste (see kernels/spmm.py; arXiv:2112.09017's "dense
+enough for the hardware" framing applied to sparsity).
+
+Layout (split=0 over brick ROWS, the only distribution — matching the
+reference's row-chunk rule):
+
+- the dense shape is padded up to ``(mb*8, nb*128)`` (``mb = ceil(m/8)``,
+  ``nb = ceil(n/128)``) and block-compressed host-side; pad rows/cols
+  are zero, the framework's pad-and-mask invariant at brick granularity;
+- each device owns the bricks intersecting its canonical dense row block
+  ``[r*c, (r+1)*c)`` (``c = pad_extent(m, p)/p`` — the SAME chunk
+  geometry dense split-0 DNDarrays use, so SpMM outputs land in
+  canonical layout with **zero collectives**, see spmm.py). A brick row
+  straddling two devices' blocks is stored by BOTH (at most one per
+  boundary); the per-entry ``bmask`` marks which of a brick's 8 rows the
+  holding device owns, so straddled rows are never double-counted;
+- per-device slabs are padded to the mesh-max brick count ``B`` with
+  zero bricks (``bmask`` all-false): physical components are EVEN —
+  ``bdata`` (p*B, 8, 128), ``bcol``/``brow`` (p*B,), ``bmask`` (p*B, 8)
+  — sharded on the slab axis, no skew regardless of structure.
+
+Metadata: ``gnnz`` is the TRUE scalar nnz, ``nbricks`` the global
+distinct stored bricks, ``occupancy = gnnz / (nbricks * 1024)`` the
+fraction of stored brick slots holding a true nonzero — the density
+model PERF.md's sparse section prices bandwidth with.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from typing import Optional, Tuple
+
+from ..core import types
+from ..core import _padding
+from ..core.communication import Communication, place as _place, sanitize_comm
+from ..core.devices import Device, sanitize_device
+from ..core.dndarray import DNDarray
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = ["DBCSR_matrix", "sparse_dbcsr_matrix", "to_dbcsr", "BRICK_SHAPE"]
+
+#: the stored block: 8 sublanes x 128 lanes — one f32 VREG tile
+BRICK_SHAPE = (8, 128)
+
+
+class DBCSR_matrix:
+    """Distributed block-CSR matrix with fixed (8, 128) bricks.
+
+    Construct via :func:`sparse_dbcsr_matrix` / :func:`to_dbcsr`; the
+    raw constructor takes pre-built physical slab components.
+    """
+
+    def __init__(
+        self,
+        bdata: jax.Array,
+        bcol: jax.Array,
+        brow: jax.Array,
+        bmask: jax.Array,
+        slab_meta: Tuple[Tuple[int, int, int], ...],
+        gnnz: int,
+        nbricks: int,
+        gshape: Tuple[int, int],
+        dtype,
+        split: Optional[int],
+        device: Device,
+        comm: Communication,
+    ):
+        if split not in (None, 0):
+            raise ValueError(f"DBCSR_matrix only supports split=0 or None, got {split}")
+        self.__bdata = bdata
+        self.__bcol = bcol
+        self.__brow = brow
+        self.__bmask = bmask
+        self.__slab_meta = tuple(tuple(int(v) for v in t) for t in slab_meta)
+        self.__gnnz = int(gnnz)
+        self.__nbricks = int(nbricks)
+        self.__gshape = (int(gshape[0]), int(gshape[1]))
+        self.__dtype = dtype
+        self.__split = split
+        self.__device = device
+        self.__comm = comm
+
+    # ------------------------------------------------------------------ #
+    # geometry                                                           #
+    # ------------------------------------------------------------------ #
+    @property
+    def mb(self) -> int:
+        """Brick rows: ceil(m / 8)."""
+        return -(-max(self.__gshape[0], 1) // BRICK_SHAPE[0])
+
+    @property
+    def nb(self) -> int:
+        """Brick columns: ceil(n / 128)."""
+        return -(-max(self.__gshape[1], 1) // BRICK_SHAPE[1])
+
+    @property
+    def slab_bricks(self) -> int:
+        """B — bricks per device slab (mesh max, pad-evened)."""
+        p = self.__comm.size if self.__split == 0 else 1
+        return int(self.__bdata.shape[0]) // max(p, 1)
+
+    @property
+    def _phys_components(self):
+        """(bdata, bcol, brow, bmask) physical slab arrays for compiled
+        kernels. Pad bricks carry zero data and an all-false mask —
+        contribution-free under the masked segment-sum."""
+        return self.__bdata, self.__bcol, self.__brow, self.__bmask
+
+    @property
+    def _slab_meta(self) -> Tuple[Tuple[int, int, int], ...]:
+        """Per-device (g0, g1, n_real): brick-row range [g0, g1) held by
+        the device and its real (non-pad) brick count."""
+        return self.__slab_meta
+
+    # ------------------------------------------------------------------ #
+    # metadata                                                           #
+    # ------------------------------------------------------------------ #
+    @property
+    def comm(self) -> Communication:
+        return self.__comm
+
+    @property
+    def device(self) -> Device:
+        return self.__device
+
+    @property
+    def dtype(self):
+        return self.__dtype
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.__gshape
+
+    @property
+    def gshape(self) -> Tuple[int, int]:
+        return self.__gshape
+
+    @property
+    def split(self) -> Optional[int]:
+        return self.__split
+
+    @property
+    def nnz(self) -> int:
+        """TRUE scalar nnz (not brick slots)."""
+        return self.__gnnz
+
+    gnnz = nnz
+
+    @property
+    def nbricks(self) -> int:
+        """Global distinct stored bricks (boundary duplicates counted once)."""
+        return self.__nbricks
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of stored brick slots that hold a true nonzero —
+        the brick-density term of the nnz-bandwidth cost model."""
+        slots = self.__nbricks * BRICK_SHAPE[0] * BRICK_SHAPE[1]
+        return self.__gnnz / slots if slots else 0.0
+
+    @property
+    def component_nbytes(self) -> int:
+        """Per-mesh resident bytes of the physical components (what
+        memcheck prices a DBCSR operand at — brick-padded, not dense)."""
+        return sum(
+            int(np.prod(a.shape, dtype=np.int64)) * np.dtype(a.dtype).itemsize
+            for a in self._phys_components
+        )
+
+    def is_distributed(self) -> bool:
+        return self.__split is not None and self.__comm.is_distributed()
+
+    # ------------------------------------------------------------------ #
+    # ops                                                                #
+    # ------------------------------------------------------------------ #
+    def __matmul__(self, other):
+        """``A @ x`` — brick SpMM (kernels/spmm.py via sparse.linalg)."""
+        from . import linalg as _slinalg
+
+        return _slinalg.matmul(self, other)
+
+    def astype(self, dtype, copy: bool = True) -> "DBCSR_matrix":
+        dtype = types.canonical_heat_type(dtype)
+        bdata = self.__bdata.astype(dtype.jax_type())
+        if not copy:
+            self.__bdata = bdata
+            self.__dtype = dtype
+            return self
+        return DBCSR_matrix(
+            bdata, self.__bcol, self.__brow, self.__bmask, self.__slab_meta,
+            self.__gnnz, self.__nbricks, self.__gshape, dtype, self.__split,
+            self.__device, self.__comm,
+        )
+
+    # ------------------------------------------------------------------ #
+    # conversions                                                        #
+    # ------------------------------------------------------------------ #
+    def _to_scipy_bsr(self):
+        """Reassemble the global scipy BSR host-side: each device
+        contributes the bricks of the rows it FIRST covers (boundary
+        bricks are deduplicated by ownership order)."""
+        import scipy.sparse as sp
+
+        bdata = np.asarray(jax.device_get(self.__bdata))
+        if bdata.dtype.itemsize < 4 and bdata.dtype.kind not in "iub":
+            # scipy kernels reject ml_dtypes (bfloat16/float16 bricks):
+            # assemble in f32, exact for every sub-f32 value
+            bdata = bdata.astype(np.float32)
+        bcol = np.asarray(jax.device_get(self.__bcol))
+        brow = np.asarray(jax.device_get(self.__brow))
+        B = self.slab_bricks
+        rows_parts, cols_parts, data_parts = [], [], []
+        prev_end = 0
+        for r, (g0, g1, nreal) in enumerate(self.__slab_meta):
+            lo, hi = r * B, r * B + nreal
+            sl_rows = brow[lo:hi]
+            keep = sl_rows >= prev_end  # rows [g0, prev_end) owned upstream
+            rows_parts.append(sl_rows[keep])
+            cols_parts.append(bcol[lo:hi][keep])
+            data_parts.append(bdata[lo:hi][keep])
+            prev_end = max(prev_end, g1)
+        browg = np.concatenate(rows_parts) if rows_parts else np.zeros(0, np.int32)
+        bcolg = np.concatenate(cols_parts) if cols_parts else np.zeros(0, np.int32)
+        bdatag = (
+            np.concatenate(data_parts)
+            if data_parts
+            else np.zeros((0,) + BRICK_SHAPE, np.dtype(self.__dtype.jax_type()))
+        )
+        mb, nb = self.mb, self.nb
+        indptr = np.zeros(mb + 1, dtype=np.int64)
+        np.add.at(indptr, browg + 1, 1)
+        indptr = np.cumsum(indptr)
+        return sp.bsr_matrix(
+            (bdatag, bcolg, indptr),
+            shape=(mb * BRICK_SHAPE[0], nb * BRICK_SHAPE[1]),
+            blocksize=BRICK_SHAPE,
+        )
+
+    def to_dcsr(self) -> DCSR_matrix:
+        """Back to the scalar-entry API format (true nonzeros only)."""
+        from .factories import _from_components
+
+        csr = self._to_scipy_bsr().tocsr()
+        csr.eliminate_zeros()
+        m, n = self.__gshape
+        csr.resize((m, n))
+        csr = csr.tocsr()
+        data = jnp.asarray(csr.data, dtype=self.__dtype.jax_type())
+        return _from_components(
+            csr.indptr.astype(np.int32), csr.indices.astype(np.int32), data,
+            (m, n), self.__split, self.__device, self.__comm,
+        )
+
+    def todense(self) -> DNDarray:
+        from ..core import factories as _factories
+
+        m, n = self.__gshape
+        dense = self._to_scipy_bsr().toarray()[:m, :n]
+        return _factories.array(
+            dense, dtype=self.__dtype, split=self.__split,
+            device=self.__device, comm=self.__comm,
+        )
+
+    to_dense = todense
+
+    def __repr__(self) -> str:
+        return (
+            f"DBCSR_matrix(shape={self.__gshape}, bricks={self.__nbricks} of "
+            f"{BRICK_SHAPE}, nnz={self.__gnnz}, occupancy={self.occupancy:.3f}, "
+            f"dtype=ht.{self.__dtype.__name__}, split={self.__split})"
+        )
+
+
+# --------------------------------------------------------------------- #
+# factories                                                             #
+# --------------------------------------------------------------------- #
+def _slab_layout(m: int, mb: int, p: int) -> Tuple[Tuple[int, int], ...]:
+    """Per-device brick-row range [g0, g1): the bricks intersecting the
+    device's canonical dense row block [r*c, (r+1)*c)."""
+    c = _padding.pad_extent(m, p) // p if p > 1 else max(m, 1)
+    out = []
+    for r in range(p):
+        lo, hi = r * c, min((r + 1) * c, mb * BRICK_SHAPE[0])
+        if hi <= lo:
+            out.append((mb, mb))
+            continue
+        g0 = min(lo // BRICK_SHAPE[0], mb)
+        g1 = min(-(-hi // BRICK_SHAPE[0]), mb)
+        out.append((g0, g1))
+    return tuple(out)
+
+
+def sparse_dbcsr_matrix(
+    obj,
+    dtype=None,
+    split: Optional[int] = None,
+    device: Optional[Device] = None,
+    comm: Optional[Communication] = None,
+) -> DBCSR_matrix:
+    """Create a DBCSR_matrix from scipy sparse, a dense array-like, a
+    DNDarray, or a DCSR_matrix. ``split=0`` distributes brick rows by
+    the canonical dense chunk geometry; ``None`` replicates."""
+    from .factories import _to_scipy_csr
+    import scipy.sparse as sp
+
+    if split is not None and split != 0:
+        raise ValueError(f"split must be 0 or None, got {split}")
+    device = sanitize_device(device)
+    comm = sanitize_comm(comm)
+
+    if isinstance(obj, DCSR_matrix):
+        if split is None and obj.split == 0:
+            split = 0
+        csr = sp.csr_matrix(
+            (
+                np.asarray(jax.device_get(obj.data)),
+                np.asarray(jax.device_get(obj.indices)),
+                np.asarray(jax.device_get(obj.indptr)),
+            ),
+            shape=obj.shape,
+        )
+        if dtype is None:
+            dtype = obj.dtype
+    else:
+        dtype_np = (
+            np.dtype(types.canonical_heat_type(dtype).jax_type())
+            if dtype is not None else None
+        )
+        csr = _to_scipy_csr(obj, dtype_np)
+
+    m, n = int(csr.shape[0]), int(csr.shape[1])
+    if dtype is None:
+        dtype = types.canonical_heat_type(csr.data.dtype if csr.nnz else np.float32)
+    else:
+        dtype = types.canonical_heat_type(dtype)
+    jt = dtype.jax_type()
+    gnnz = int(csr.nnz)
+
+    mb = -(-max(m, 1) // BRICK_SHAPE[0])
+    nb = -(-max(n, 1) // BRICK_SHAPE[1])
+    csr = csr.astype(np.dtype(jt)).copy()
+    csr.resize((mb * BRICK_SHAPE[0], nb * BRICK_SHAPE[1]))
+    bsr = csr.tobsr(blocksize=BRICK_SHAPE)
+    bsr.sort_indices()
+    bindptr = bsr.indptr.astype(np.int64)
+    bcol_g = bsr.indices.astype(np.int32)
+    bdata_g = np.asarray(bsr.data)
+    nbricks = int(bcol_g.shape[0])
+    brow_g = np.repeat(
+        np.arange(mb, dtype=np.int32), np.diff(bindptr).astype(np.int64)
+    )
+
+    p = comm.size if split == 0 else 1
+    c = _padding.pad_extent(m, p) // p if p > 1 else max(m, 1)
+    ranges = _slab_layout(m, mb, p)
+    counts = [int(bindptr[g1] - bindptr[g0]) for g0, g1 in ranges]
+    B = max(1, max(counts) if counts else 1)
+
+    bdata = np.zeros((p * B, *BRICK_SHAPE), dtype=np.dtype(jt))
+    bcol = np.zeros((p * B,), dtype=np.int32)
+    brow = np.zeros((p * B,), dtype=np.int32)
+    bmask = np.zeros((p * B, BRICK_SHAPE[0]), dtype=bool)
+    slab_meta = []
+    for r, (g0, g1) in enumerate(ranges):
+        s0, s1 = int(bindptr[g0]), int(bindptr[g1])
+        nreal = s1 - s0
+        lo = r * B
+        bdata[lo : lo + nreal] = bdata_g[s0:s1]
+        bcol[lo : lo + nreal] = bcol_g[s0:s1]
+        rows_r = brow_g[s0:s1]
+        brow[lo : lo + nreal] = rows_r
+        # which of each brick's 8 dense rows fall in THIS device's block
+        dense_rows = rows_r[:, None] * BRICK_SHAPE[0] + np.arange(
+            BRICK_SHAPE[0], dtype=np.int32
+        )
+        blk_lo, blk_hi = r * c, (r + 1) * c
+        bmask[lo : lo + nreal] = (dense_rows >= blk_lo) & (dense_rows < blk_hi)
+        slab_meta.append((g0, g1, nreal))
+
+    slab_split = 0 if split == 0 else None
+    return DBCSR_matrix(
+        _place(jnp.asarray(bdata), comm.sharding(3, slab_split)),
+        _place(jnp.asarray(bcol), comm.sharding(1, slab_split)),
+        _place(jnp.asarray(brow), comm.sharding(1, slab_split)),
+        _place(jnp.asarray(bmask), comm.sharding(2, slab_split)),
+        tuple(slab_meta),
+        gnnz,
+        nbricks,
+        (m, n),
+        dtype,
+        split,
+        device,
+        comm,
+    )
+
+
+def to_dbcsr(A, split: Optional[int] = None) -> DBCSR_matrix:
+    """Convert a DCSR_matrix / DNDarray / array-like to DBCSR, keeping
+    the source's distribution unless ``split`` overrides it."""
+    if isinstance(A, DCSR_matrix):
+        return sparse_dbcsr_matrix(
+            A, split=A.split if split is None else split,
+            device=A.device, comm=A.comm,
+        )
+    if isinstance(A, DNDarray):
+        return sparse_dbcsr_matrix(
+            A, split=A.split if split is None else split,
+            device=A.device, comm=A.comm,
+        )
+    return sparse_dbcsr_matrix(A, split=split)

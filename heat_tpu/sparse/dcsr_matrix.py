@@ -131,6 +131,10 @@ class DCSR_matrix:
             # map would add O(gnnz) resident bytes per device
             if self.__split == 0:
                 rows = _place(rows, self.__comm.sharding(1, 0))
+            if isinstance(rows, jax.core.Tracer):
+                # first touch happened under a trace: caching the tracer
+                # would leak it past the trace's lifetime
+                return rows
             self.__rows_cache = rows
         return self.__rows_cache
 
@@ -140,6 +144,16 @@ class DCSR_matrix:
         arrays for compiled kernels (pad entries hold zeros: framework
         invariant, contribution-free under segment_sum)."""
         return self.__indptr, self.__indices, self.__data
+
+    @property
+    def component_nbytes(self) -> int:
+        """Total bytes of the stored (nnz-padded) components — what the
+        operand actually occupies, the number memcheck and the sparse
+        transfer pricing use instead of the dense ``m * n`` shape."""
+        return sum(
+            int(np.prod(c.shape, dtype=np.int64)) * np.dtype(c.dtype).itemsize
+            for c in self._phys_components
+        )
 
     @property
     def larray(self):

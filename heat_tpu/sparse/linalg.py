@@ -1,16 +1,26 @@
-"""Sparse linear algebra: SpMV / SpMM for ``DCSR_matrix``.
+"""Sparse linear algebra: SpMV / SpMM / SDDMM for the sparse formats.
 
 The reference's sparse package stops at elementwise ops; a TPU framework
 whose sparse type cannot multiply is a shell, so this EXCEEDS reference
-parity. The formulation is segment-sum based — the gather/segment-sum
-pair is XLA's native sparse-contraction idiom (what
-``jax.experimental.sparse`` BCOO lowers to) and runs on the sharded
-component arrays:
+parity. Two engines, dispatched on the operand type:
 
-    rows  = searchsorted(indptr, iota(nnz), 'right') - 1   (cached)
-    y     = segment_sum(data * x[indices], rows, m)
+* ``DCSR_matrix`` — the segment-sum formulation over the scalar-entry
+  components (the gather/segment-sum pair is XLA's native
+  sparse-contraction idiom, what ``jax.experimental.sparse`` BCOO
+  lowers to)::
 
-For a matrix operand the multiply broadcasts over the dense columns.
+      rows  = searchsorted(indptr, iota(nnz), 'right') - 1   (cached)
+      y     = segment_sum(data * x[indices], rows, m)
+
+* ``DBCSR_matrix`` — the brick engine (kernels/spmm.py): dense
+  (8,128)x(128,k) brick matmuls behind ``HEAT_TPU_SPMM_KERNEL``,
+  shard_map-local on a real mesh (0 collectives).
+
+A split dense operand is resharded to replicated through
+``comm.reshard_phys`` FIRST — a planner-stamped plan (shardlint
+info-downgrades it), never an implicit GSPMD reshard inside the
+contraction program. Sub-f32 data accumulates in f32 and casts back at
+the end (SL601-clean by construction).
 """
 
 from __future__ import annotations
@@ -27,8 +37,16 @@ from typing import Union
 from ..core import types
 from ..core.dndarray import DNDarray
 from .dcsr_matrix import DCSR_matrix
+from .dbcsr_matrix import DBCSR_matrix
 
-__all__ = ["matmul"]
+__all__ = ["matmul", "sddmm"]
+
+
+def _acc_name(jt) -> str:
+    """Accumulation dtype name: f32 for sub-f32 data (SL601)."""
+    if jnp.dtype(jt) in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+        return "float32"
+    return np.dtype(jt).name
 
 
 @functools.lru_cache(maxsize=256)
@@ -39,34 +57,41 @@ def _spmm_program(comm, m: int, out_ndim: int, out_split, jdtype: str):
     by framework invariant), so no unpad pass runs; ``rows`` is the
     per-matrix cached COO row map (pad rows map past m and are dropped
     by segment_sum). jit retraces per operand shape, so neither nnz nor
-    the dense column count needs a cache key."""
+    the dense column count needs a cache key. Accumulation runs in
+    ``acc`` (f32 for bf16/f16 inputs), the result casts to ``jdtype``."""
     from ..core import _padding
 
     def run(rows, indices, data, x):
         jt = jnp.dtype(jdtype)
-        gathered = x.astype(jt)[indices]          # (nnz,) or (nnz, k)
+        acc = jnp.dtype(_acc_name(jt))
+        gathered = x.astype(acc)[indices]         # (nnz,) or (nnz, k)
         if gathered.ndim == 1:
-            contrib = data.astype(jt) * gathered
+            contrib = data.astype(acc) * gathered
         else:
-            contrib = data.astype(jt)[:, None] * gathered
-        y = jax.ops.segment_sum(contrib, rows, num_segments=m)
+            contrib = data.astype(acc)[:, None] * gathered
+        y = jax.ops.segment_sum(contrib, rows, num_segments=m).astype(jt)
         return _padding.pad_logical(y, out_split, comm.size)
 
     return comm.jit_sharded(run, out_ndim, out_split)
 
 
-def matmul(A: DCSR_matrix, x: Union[DNDarray, jax.Array, np.ndarray]) -> DNDarray:
-    """``A @ x`` for a distributed CSR matrix and a dense vector/matrix.
-
-    Returns a DNDarray of shape (m,) or (m, k), split along axis 0 when
-    ``A`` is row-distributed (matching A's distribution rule).
-    """
-    if not isinstance(A, DCSR_matrix):
-        raise TypeError(f"A must be a DCSR_matrix, got {type(A)}")
+def _dense_operand(A, x) -> jax.Array:
+    """Normalize the dense operand to a replicated logical jax array.
+    A split DNDarray moves through the redistribution planner (a
+    plan-stamped reshard), never through an implicit GSPMD reshard
+    inside the contraction program."""
     if isinstance(x, DNDarray):
-        xarr = x.larray
-    else:
-        xarr = jnp.asarray(np.asarray(x)) if not isinstance(x, jax.Array) else x
+        if x.split is not None and x.comm.is_distributed():
+            return x.comm.reshard_phys(x.larray, x.gshape, x.split, None)
+        from ..core import _padding
+
+        return _padding.unpad(x.larray, x.gshape, x.split)
+    if isinstance(x, jax.Array):
+        return x
+    return jnp.asarray(np.asarray(x))
+
+
+def _check_operand(A, xarr):
     if xarr.ndim not in (1, 2):
         raise ValueError(f"dense operand must be 1-D or 2-D, got {xarr.ndim}-D")
     m, n = A.shape
@@ -74,15 +99,121 @@ def matmul(A: DCSR_matrix, x: Union[DNDarray, jax.Array, np.ndarray]) -> DNDarra
         raise ValueError(
             f"dimension mismatch: A is {A.shape}, dense operand has leading dim {xarr.shape[0]}"
         )
+
+
+def matmul(
+    A: Union[DCSR_matrix, DBCSR_matrix],
+    x: Union[DNDarray, jax.Array, np.ndarray],
+) -> DNDarray:
+    """``A @ x`` for a distributed sparse matrix and a dense
+    vector/matrix.
+
+    Returns a DNDarray of shape (m,) or (m, k), split along axis 0 when
+    ``A`` is row-distributed (matching A's distribution rule).
+    """
+    if isinstance(A, DBCSR_matrix):
+        return _matmul_bcsr(A, x)
+    if not isinstance(A, DCSR_matrix):
+        raise TypeError(f"A must be a DCSR_matrix or DBCSR_matrix, got {type(A)}")
+    xarr = _dense_operand(A, x)
+    _check_operand(A, xarr)
+    m, n = A.shape
     out_dtype = types.promote_types(A.dtype, types.canonical_heat_type(xarr.dtype))
     jt = out_dtype.jax_type()
     comm = A.comm
     split = 0 if A.split == 0 else None
     gshape = (m,) if xarr.ndim == 1 else (m, int(xarr.shape[1]))
     _, phys_indices, phys_data = A._phys_components
+    if A.gnnz == 0 or int(phys_indices.shape[0]) == 0:
+        # all-zero matrix: no stored elements to contract — the zero
+        # result comes straight from the factories (segment_sum over a
+        # zero-length operand would still compile a program per shape)
+        from ..core import factories as _factories
+
+        return _factories.zeros(
+            gshape, dtype=out_dtype, split=split, device=A.device, comm=comm
+        )
     prog = _spmm_program(comm, m, len(gshape), split, np.dtype(jt).name)
     phys = prog(A._rows, phys_indices, phys_data, xarr)
     return DNDarray(phys, gshape, out_dtype, split, A.device, comm)
+
+
+def _matmul_bcsr(A: DBCSR_matrix, x) -> DNDarray:
+    """Brick-engine SpMM: decide the path, run the (shard_map-local)
+    brick program, wrap the canonical physical output."""
+    from ..kernels import spmm as _spmm
+
+    xarr = _dense_operand(A, x)
+    _check_operand(A, xarr)
+    m, n = A.shape
+    out_dtype = types.promote_types(A.dtype, types.canonical_heat_type(xarr.dtype))
+    jt = out_dtype.jax_type()
+    comm = A.comm
+    split = 0 if A.split == 0 else None
+    out_ndim = xarr.ndim
+    gshape = (m,) if out_ndim == 1 else (m, int(xarr.shape[1]))
+    x2d = xarr if out_ndim == 2 else xarr[:, None]
+    k = int(x2d.shape[1])
+    bdata, bcol, brow, bmask = A._phys_components
+    B = A.slab_bricks
+    path = _spmm.decide("spmm", B, k, np.dtype(jt).name)
+    prog = _spmm.spmm_bcsr_program(
+        comm, m, A.nb, B, split, out_ndim, np.dtype(jt).name, path
+    )
+    phys = prog(bdata, bcol, brow, bmask, x2d)
+    return DNDarray(phys, gshape, out_dtype, split, A.device, comm)
+
+
+def sddmm(
+    S: DBCSR_matrix,
+    u: Union[DNDarray, jax.Array, np.ndarray],
+    v: Union[DNDarray, jax.Array, np.ndarray],
+) -> DBCSR_matrix:
+    """Sampled dense-dense matmul: ``C = S ∘ (u @ vᵀ)`` computed ONLY on
+    the stored bricks of ``S`` (pattern preserved, pad bricks stay
+    zero). ``u`` is (m, d), ``v`` is (n, d); the result is a
+    DBCSR_matrix sharing S's slab structure."""
+    from ..kernels import spmm as _spmm
+
+    if not isinstance(S, DBCSR_matrix):
+        raise TypeError(f"S must be a DBCSR_matrix, got {type(S)}")
+    uarr = _dense_operand(S, u)
+    varr = _dense_operand(S, v)
+    m, n = S.shape
+    if uarr.ndim != 2 or varr.ndim != 2:
+        raise ValueError("sddmm operands must be 2-D (m, d) and (n, d)")
+    if uarr.shape[0] != m or varr.shape[0] != n:
+        raise ValueError(
+            f"dimension mismatch: S is {S.shape}, u is {tuple(uarr.shape)}, "
+            f"v is {tuple(varr.shape)}"
+        )
+    if uarr.shape[1] != varr.shape[1]:
+        raise ValueError(
+            f"sddmm inner dims differ: {uarr.shape[1]} vs {varr.shape[1]}"
+        )
+    out_dtype = types.promote_types(
+        S.dtype,
+        types.promote_types(
+            types.canonical_heat_type(uarr.dtype),
+            types.canonical_heat_type(varr.dtype),
+        ),
+    )
+    jt = out_dtype.jax_type()
+    comm = S.comm
+    split = 0 if S.split == 0 else None
+    sdata, bcol, brow, bmask = S._phys_components
+    B = S.slab_bricks
+    d = int(uarr.shape[1])
+    path = _spmm.decide("sddmm", B, d, np.dtype(jt).name)
+    prog = _spmm.sddmm_bcsr_program(
+        comm, S.mb, S.nb, B, split, np.dtype(jt).name, path
+    )
+    new_bdata = prog(sdata, bcol, brow, uarr, varr)
+    return DBCSR_matrix(
+        new_bdata, bcol, brow, bmask, S._slab_meta, S.gnnz, S.nbricks,
+        S.shape, out_dtype, S.split, S.device, comm,
+    )
+
 
 from ..core.communication import register_mesh_cache
 

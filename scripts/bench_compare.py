@@ -96,6 +96,14 @@ HIGHER_IS_BETTER = {
     # the lattice's host->disk durable-commit bound (floor 0.5 pinned)
     "write_gbps",
     "bound_frac",
+    # sparse-engine acceptance fields (ISSUE 18): spmm_1gb's achieved
+    # fraction of the lattice's nnz-weighted wire-mass floor (>= 0.5
+    # pinned on CPU) and its same-run dense-matmul-twin ratio; the
+    # pagerank_2m scenario's edge throughput (`gbps` above covers the
+    # nnz-bandwidth figure itself)
+    "nnz_bw_frac",
+    "vs_dense_matmul",
+    "edges_per_s",
 }
 
 # rows that changed name across rounds: a baseline row under the old
@@ -139,6 +147,10 @@ LOWER_IS_BETTER = {
     # figure (the ci.sh calibration leg's shrinkage gate)
     "mean_abs_model_error",
     "mean_abs_calibrated_error",
+    # ISSUE 18: pagerank_2m's iterations-to-tol — deterministic for the
+    # seeded graph, so growth means an engine numerics change slowed
+    # the fixpoint, not weather
+    "iterations",
 }
 
 

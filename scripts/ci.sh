@@ -402,6 +402,20 @@ print(f"calibration loop closure: mean |model_error| "
       f"over {rep['n_legs']} leg(s), profile {prof['profile_id']}")
 EOF
 
+# spmm-kernel legs (ISSUE 18), mirroring the sort/relayout legs: the
+# brick SpMM/SDDMM family FORCED onto the Pallas scalar-prefetch
+# kernels (interpret mode on CPU) over the sparse + graph suites —
+# every workload from raw brick matmuls through the PageRank fixpoint
+# and spectral embedding runs kernel-backed against the same oracles;
+# and the HEAT_TPU_SPMM_KERNEL=0 escape hatch over the same surface,
+# proving the gather-free XLA formulation is bit-identical. (The
+# 5-device odd-mesh leg above already replays the sparse suite: it
+# runs all of tests/, which includes test_spmm.py/test_graph.py/
+# test_sparse.py since this ISSUE.)
+HEAT_TPU_SPMM_KERNEL=1 python -m pytest tests/test_spmm.py tests/test_sparse.py tests/test_graph.py -q "$@"
+
+HEAT_TPU_SPMM_KERNEL=0 python -m pytest tests/test_spmm.py tests/test_sparse.py tests/test_graph.py -q "$@"
+
 if [ -f BENCH_DETAIL.json ] && ls BENCH_r*.json >/dev/null 2>&1; then
   # the regex holds every DETERMINISTIC analytic field
   # (model_speedup, tier_model_speedup, stage_model_gbps, ...) to exact

@@ -883,3 +883,63 @@ def f32_request_program(x):
     import jax.numpy as jnp
 
     return jnp.cumsum(x.astype(jnp.float32))
+
+
+# --------------------------------------------------------------------- #
+# ISSUE 18: sparse-engine fixtures                                      #
+# --------------------------------------------------------------------- #
+def gather_per_row_spmv_program(comm, m, rows, indices, data, x):
+    """ISSUE 18 golden bad-fixture: gather-the-world SpMV.
+
+    The anti-pattern the brick engine exists to avoid — three
+    violations:
+
+    - SL101: the dense operand relays to the OTHER split through a bare
+      sharding constraint (an implicit all-to-all no redistribution plan
+      stamped; the engine routes this through ``comm.reshard_phys``);
+    - SL102: the nnz-sharded stored values materialize replicated (an
+      all-gather of every stored element — the engine's shard_map local
+      program needs only the device's own brick slab);
+    - SL103: the gathered values then feed a full dense reduction (the
+      per-multiply normalization), where a local reduce + small
+      all-reduce moves O(1/p) of the bytes.
+
+    The sparse components arrive as TRACED arguments (the caller must
+    not close over them: a closure-captured component is inlined as a
+    replicated constant, and the gathers this fixture exists to pin
+    vanish from the compiled program).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # SL101: bare constraint pins the dense operand to split 1
+    xs = lax.with_sharding_constraint(x, comm.sharding(x.ndim, 1))
+    # SL102: every stored element gathered to every device
+    data_r = lax.with_sharding_constraint(data, comm.sharding(1, None))
+    idx_r = lax.with_sharding_constraint(indices, comm.sharding(1, None))
+    rows_r = lax.with_sharding_constraint(rows, comm.sharding(1, None))
+    contrib = data_r[:, None] * jnp.take(xs, idx_r, axis=0)
+    y = jax.ops.segment_sum(contrib, rows_r, num_segments=m)
+    # SL103: the replicated gather feeds a full reduction
+    return y / jnp.sum(data_r)
+
+
+def make_pagerank_step(comm, m, nb, B, alpha=0.85):
+    """The device program of one PageRank sweep — the engine SpMV plus
+    the damping/teleport affine map. Pinned LINT-CLEAN (ircheck +
+    memcheck + numcheck) by tests/test_analysis.py: the fixpoint loop's
+    entire device side must stay collective-free on the local program
+    and free of implicit reshards."""
+    import jax
+    import jax.numpy as jnp
+
+    from heat_tpu.kernels import spmm as kspmm
+
+    spmv = kspmm.spmm_bcsr_program(comm, m, nb, B, 0, 1, "float32", "xla")
+
+    def step(bdata, bcol, brow, bmask, r, teleport):
+        y = spmv(bdata, bcol, brow, bmask, r[:, None])
+        return y * jnp.float32(alpha) + teleport
+
+    return step

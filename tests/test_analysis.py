@@ -661,6 +661,95 @@ class TestBenchCompareNewRows(TestCase):
         self.assertEqual(res3["waived"], 0)
 
 
+class TestSparseEngineFixtures(TestCase):
+    """ISSUE 18: the sparse-engine golden fixtures — the gather-per-row
+    SpMV anti-pattern trips SL101/SL103, and the engine's kernel SpMM
+    and PageRank step programs pin LINT-CLEAN across ircheck, memcheck
+    and numcheck."""
+
+    def _sparse_split0(self, n=327680):
+        import numpy as np
+        import scipy.sparse as sp
+
+        rng = np.random.default_rng(0x18)
+        m, nnz = 4096, 400000
+        rows = rng.integers(0, m, nnz)
+        cols = rng.integers(0, n, nnz)
+        csr = sp.csr_matrix(
+            (rng.random(nnz).astype(np.float32), (rows, cols)), shape=(m, n)
+        )
+        csr.sum_duplicates()
+        return csr
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_gather_per_row_spmv_trips_sl101_sl103(self):
+        # narrow dense operand: the nnz gathers must dominate the
+        # largest input for SL102 to reach error severity (gating)
+        csr = self._sparse_split0(n=32768)
+        A = ht.sparse.sparse_csr_matrix(csr, split=0)
+        x = ht.random.randn(csr.shape[1], 16, split=0)
+        comm, m = A.comm, A.shape[0]
+        # components passed as TRACED args — closure capture would
+        # constant-fold them replicated and hide the gathers
+        rep = ht.analysis.check(
+            lambda r, i, d, v: fx.gather_per_row_spmv_program(comm, m, r, i, d, v),
+            A._rows, *A._phys_components[1:], x._phys,
+            min_bytes=1 << 17,
+        )
+        ids = set(rep.rule_ids)
+        self.assertIn("SL101", ids)  # bare constraint -> implicit all-to-all
+        self.assertIn("SL103", ids)  # gathered values feed a reduction
+        self.assertIn("SL102", ids)  # the gather itself materializes
+        self.assertFalse(rep.ok)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_kernel_spmm_path_is_lint_clean(self):
+        """The engine's distributed SpMM local program: no implicit
+        reshards (the dense operand arrives replicated BY PLAN), no
+        collectives at all, honest memory facts, f32-accumulating."""
+        import numpy as np
+
+        from heat_tpu.kernels import spmm as kspmm
+
+        csr = self._sparse_split0()
+        A = ht.sparse.sparse_dbcsr_matrix(csr, split=0)
+        bdata, bcol, brow, bmask = A._phys_components
+        x = np.ones((csr.shape[1], 4), np.float32)
+        prog = kspmm.spmm_bcsr_program(
+            A.comm, A.shape[0], A.nb, A.slab_bricks, 0, 2, "float32", "xla"
+        )
+        rep = ht.analysis.check(prog, bdata, bcol, brow, bmask, x)
+        self.assertEqual([f for f in rep.findings if f.severity == "error"], [])
+        self.assertEqual(
+            [f for f in rep.findings if f.rule in ("SL101", "SL102", "SL103")],
+            [],
+        )
+        mem = ht.analysis.memcheck(prog, bdata, bcol, brow, bmask, x)
+        self.assertTrue(mem.ok)
+        num = ht.analysis.numcheck(prog, bdata, bcol, brow, bmask, x)
+        self.assertTrue(num.ok)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_pagerank_step_program_is_lint_clean(self):
+        import numpy as np
+
+        csr = self._sparse_split0().T.tocsr()  # (n, m): square not needed
+        csr = csr[: csr.shape[1], :].tocsr()
+        A = ht.sparse.sparse_dbcsr_matrix(csr, split=0)
+        bdata, bcol, brow, bmask = A._phys_components
+        step = fx.make_pagerank_step(
+            A.comm, A.shape[0], A.nb, A.slab_bricks, alpha=0.85
+        )
+        r = np.full(csr.shape[1], 1.0 / csr.shape[1], np.float32)
+        tel = np.float32(0.15 / csr.shape[1])
+        rep = ht.analysis.check(step, bdata, bcol, brow, bmask, r, tel)
+        self.assertEqual([f for f in rep.findings if f.severity == "error"], [])
+        mem = ht.analysis.memcheck(step, bdata, bcol, brow, bmask, r, tel)
+        self.assertTrue(mem.ok)
+        num = ht.analysis.numcheck(step, bdata, bcol, brow, bmask, r, tel)
+        self.assertTrue(num.ok)
+
+
 if __name__ == "__main__":
     import unittest
 

@@ -192,6 +192,7 @@ class TestGateRegistry(TestCase):
             params = set(inspect.signature(builder.__wrapped__).parameters)
             for spec in gates.scope_gates("program"):
                 if spec.name in ("HEAT_TPU_SORT_KERNEL", "HEAT_TPU_RELAYOUT_KERNEL",
+                                 "HEAT_TPU_SPMM_KERNEL",
                                  "HEAT_TPU_REDIST_PLANNER"):
                     continue  # keyed one level down (impl strings / route)
                 self.assertTrue(

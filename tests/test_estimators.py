@@ -4,6 +4,8 @@ per-subpackage tests/ with synthetic data)."""
 
 import numpy as np
 
+import jax.numpy as jnp
+
 import heat_tpu as ht
 
 from test_suites.basic_test import TestCase
@@ -312,3 +314,116 @@ class TestPallasFusedAssign(TestCase):
         )
         np.testing.assert_allclose(np.asarray(ref[0]), new_centers, rtol=2e-4, atol=2e-4)
         np.testing.assert_allclose(float(ref[2]), float(inertia), rtol=2e-4)
+
+
+class TestSparseEncoders(TestCase):
+    """ISSUE 18: transforms that EMIT sparse outputs — one-hot and
+    TF-IDF return DCSR matrices, register as serving ``transform``
+    endpoints, and stream host-resident inputs with stage_out
+    writeback."""
+
+    def _codes(self, n=30, seed=40):
+        rng = np.random.default_rng(seed)
+        return np.stack(
+            [rng.integers(0, 4, n), rng.integers(10, 13, n), rng.integers(-2, 1, n)],
+            axis=1,
+        ).astype(np.int32)
+
+    def test_onehot_sparse_output_matches_dense_oracle(self):
+        codes = self._codes()
+        enc = ht.preprocessing.OneHotEncoder().fit(codes)
+        out = enc.transform(codes)
+        self.assertIsInstance(out, ht.sparse.DCSR_matrix)
+        self.assertEqual(out.shape, (30, enc.n_features_out_))
+        self.assertEqual(out.nnz, 30 * 3)  # exactly one 1.0 per (row, feature)
+        dense = out.todense().numpy()
+        # invert: each feature block's argmax recovers the code
+        for f, cats in enumerate(enc.categories_):
+            lo = int(enc._offsets[f])
+            block = dense[:, lo : lo + len(cats)]
+            np.testing.assert_array_equal(cats[block.argmax(1)], codes[:, f])
+            np.testing.assert_allclose(block.sum(1), 1.0)
+
+    def test_onehot_unknown_category_encodes_zero_block(self):
+        codes = self._codes()
+        enc = ht.preprocessing.OneHotEncoder().fit(codes)
+        probe = codes[:2].copy()
+        probe[0, 1] = 99  # unseen at fit time
+        dense = enc.transform(probe).todense().numpy()
+        lo = int(enc._offsets[1])
+        hi = int(enc._offsets[2])
+        np.testing.assert_array_equal(dense[0, lo:hi], 0.0)
+        self.assertAlmostEqual(float(dense[1].sum()), 3.0)
+
+    def test_onehot_serving_program_and_endpoint(self):
+        codes = self._codes()
+        enc = ht.preprocessing.OneHotEncoder().fit(codes)
+        spec = enc.serving_program()
+        run = spec["build"]()
+        batch = jnp.asarray(codes[:8])
+        got = np.asarray(run(batch, *spec["args"]))
+        ref = enc.transform(codes[:8]).todense().numpy()
+        np.testing.assert_array_equal(got, ref)
+        # and the public endpoint constructor accepts the transformer
+        ep = ht.serving.transform_endpoint(enc, buckets=(8,))
+        self.assertEqual(ep.name, "onehot-transform")
+
+    def test_onehot_stream_transform_writeback(self):
+        codes = self._codes(n=200, seed=41)
+        enc = ht.preprocessing.OneHotEncoder().fit(codes)
+        streamed = enc.stream_transform(codes, slab=1 << 10)  # many windows
+        ref = enc.transform(codes).todense().numpy()
+        np.testing.assert_array_equal(streamed, ref)
+
+    def _counts(self, n=25, v=12, seed=42):
+        rng = np.random.default_rng(seed)
+        counts = rng.poisson(0.6, (n, v)).astype(np.float32)
+        counts[0] = 0  # an empty document: norm must not divide by zero
+        return counts
+
+    def test_tfidf_matches_sklearn_formula(self):
+        counts = self._counts()
+        tf = ht.preprocessing.TfidfTransformer().fit(counts)
+        out = tf.transform(counts)
+        self.assertIsInstance(out, ht.sparse.DCSR_matrix)
+        n, v = counts.shape
+        df = (counts > 0).sum(0)
+        idf = np.log((1.0 + n) / (1.0 + df)) + 1.0
+        ref = counts * idf[None, :]
+        norms = np.linalg.norm(ref, axis=1, keepdims=True)
+        ref = np.divide(ref, norms, out=np.zeros_like(ref), where=norms > 0)
+        np.testing.assert_allclose(out.todense().numpy(), ref, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(tf.idf_, idf.astype(np.float32), rtol=1e-6)
+
+    def test_tfidf_preserves_sparsity_pattern(self):
+        counts = self._counts(seed=43)
+        out = ht.preprocessing.TfidfTransformer().fit(counts).transform(counts)
+        self.assertEqual(out.nnz, int((counts != 0).sum()))
+
+    def test_tfidf_serving_and_stream_agree(self):
+        counts = self._counts(n=150, seed=44)
+        tf = ht.preprocessing.TfidfTransformer().fit(counts)
+        ref = tf.transform(counts).todense().numpy()
+        spec = tf.serving_program()
+        run = spec["build"]()
+        got = np.asarray(run(jnp.asarray(counts), *spec["args"]))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        streamed = tf.stream_transform(counts, slab=1 << 11)
+        np.testing.assert_allclose(streamed, ref, rtol=1e-5, atol=1e-6)
+        ep = ht.serving.transform_endpoint(tf, buckets=(8,))
+        self.assertEqual(ep.name, "tfidf-transform")
+
+    def test_fit_validation(self):
+        enc = ht.preprocessing.OneHotEncoder()
+        with self.assertRaises(TypeError):
+            enc.fit(np.zeros((4, 2), np.float32))  # float codes rejected
+        with self.assertRaises(RuntimeError):
+            enc.transform(self._codes())
+        enc.fit(self._codes())
+        with self.assertRaises(ValueError):
+            enc.transform(self._codes()[:, :2])
+        tf = ht.preprocessing.TfidfTransformer()
+        with self.assertRaises(RuntimeError):
+            tf.transform(self._counts())
+        with self.assertRaises(ValueError):
+            ht.preprocessing.TfidfTransformer(norm="l1")

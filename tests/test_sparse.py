@@ -7,6 +7,7 @@ import pytest
 import scipy.sparse as sp
 
 import jax
+import jax.numpy as jnp
 
 import heat_tpu as ht
 from heat_tpu.sparse import (
@@ -285,3 +286,80 @@ class TestSparseMatmul:
         A = ht.sparse.sparse_csr_matrix(sp.csr_matrix(dense), split=0)
         x = np.ones(6, np.float32)
         np.testing.assert_allclose((A @ ht.array(x)).numpy(), dense @ x)
+
+
+class TestSpMVEdgeCases:
+    """ISSUE 18 satellite: the DCSR segment-sum SpMV at its degenerate
+    geometries — empty rows, all-zero matrices, nnz not divisible by the
+    mesh, sub-f32 data — pinned against the scipy/numpy oracle at EVERY
+    mesh size (the 5-device CI leg replays this suite on the odd mesh)."""
+
+    def test_all_zero_matrix_short_circuits(self):
+        import scipy.sparse as sp
+
+        A = ht.sparse.sparse_csr_matrix(sp.csr_matrix((12, 7), dtype=np.float32), split=0)
+        assert A.nnz == 0
+        y = A @ np.ones(7, np.float32)
+        np.testing.assert_array_equal(y.numpy(), np.zeros(12, np.float32))
+        Y = ht.sparse.matmul(A, np.ones((7, 3), np.float32))
+        np.testing.assert_array_equal(Y.numpy(), np.zeros((12, 3), np.float32))
+        assert Y.split == 0
+
+    def test_nnz_not_divisible_by_mesh(self):
+        """nnz coprime to every plausible device count: the padded
+        nnz-sharding must stay contribution-free."""
+        import scipy.sparse as sp
+
+        p = len(jax.devices())
+        rng = np.random.default_rng(31)
+        m, n, nnz = 23, 17, 97  # all prime — never divisible by p > 1
+        rows = rng.integers(0, m, nnz)
+        cols = rng.integers(0, n, nnz)
+        csr = sp.csr_matrix(
+            (rng.standard_normal(nnz).astype(np.float32), (rows, cols)), shape=(m, n)
+        )
+        csr.sum_duplicates()
+        assert csr.nnz % max(p, 2) != 0 or p == 1
+        A = ht.sparse.sparse_csr_matrix(csr, split=0)
+        x = rng.standard_normal(n).astype(np.float32)
+        np.testing.assert_allclose((A @ x).numpy(), csr @ x, rtol=1e-5, atol=1e-5)
+
+    def test_bf16_accumulates_in_f32(self):
+        """Sub-f32 data widens to f32 inside the contraction (SL601) —
+        long rows keep far better error than a bf16 accumulator would."""
+        import scipy.sparse as sp
+
+        rng = np.random.default_rng(32)
+        n = 4096
+        dense = np.zeros((4, n), np.float32)
+        dense[1] = rng.random(n).astype(np.float32)  # one long row
+        A = ht.sparse.sparse_csr_matrix(sp.csr_matrix(dense), split=0).astype(ht.bfloat16)
+        x = jnp.ones(n, jnp.bfloat16)
+        y = A @ x
+        assert y.dtype == ht.bfloat16  # bf16 in, bf16 out (promotion)
+        ref = dense.astype(np.float32) @ x
+        # bf16 accumulation over 4096 terms would drift percents; the
+        # f32 accumulator keeps the relative error at bf16 ULP scale
+        np.testing.assert_allclose(
+            y.numpy().astype(np.float32)[1], ref[1], rtol=1e-2
+        )
+
+    def test_odd_mesh_parity_vs_oracle(self):
+        """Shape/nnz sweep vs scipy — the divisibility sweep the odd
+        (5-device) CI leg exists for."""
+        import scipy.sparse as sp
+
+        rng = np.random.default_rng(33)
+        for m, n, nnz, k in ((5, 5, 3, None), (41, 29, 111, 2), (64, 128, 513, 7)):
+            rows = rng.integers(0, m, nnz)
+            cols = rng.integers(0, n, nnz)
+            csr = sp.csr_matrix(
+                (rng.standard_normal(nnz).astype(np.float32), (rows, cols)),
+                shape=(m, n),
+            )
+            csr.sum_duplicates()
+            A = ht.sparse.sparse_csr_matrix(csr, split=0)
+            x = rng.standard_normal((n,) if k is None else (n, k)).astype(np.float32)
+            np.testing.assert_allclose(
+                ht.sparse.matmul(A, x).numpy(), csr @ x, rtol=1e-5, atol=1e-5
+            )
