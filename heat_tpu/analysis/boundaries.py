@@ -108,6 +108,18 @@ HOST_FUNCS: Dict[Tuple[str, str], str] = {
         "reweighted window lands in the host output buffer (stage_out "
         "of the proven staging schedule)"
     ),
+    ("core/linalg/factorizations.py", "_solve_host_rhs"): (
+        "staged solve against a host-resident RHS panel whose contract "
+        "is a HOST result (ISSUE 19): each column window's solution is "
+        "written back into the host output buffer (stage_out of the "
+        "staging schedule it registers — the stream_transform pattern)"
+    ),
+    ("core/linalg/svd.py", "_svd_host"): (
+        "staged values-only svd of a host-resident operand whose "
+        "contract is a HOST-derived result (ISSUE 19): the Gram-pass "
+        "singular values cross to the host once at the end of the "
+        "stream (O(n) scalars against the O(mn) windowed operand)"
+    ),
 }
 
 # (path suffix, qualname) -> reason. Eager-only data-dependent-shape ops.
@@ -128,6 +140,11 @@ DATA_DEPENDENT_BOUNDARIES: Dict[Tuple[str, str], str] = {
         "adaptive-rank hSVD reads the singular values to choose the rank "
         "the next merge level keeps — the rank IS data-dependent output "
         "shape (reference svdtools.py truncates on the host identically)"
+    ),
+    ("core/linalg/factorizations.py", "_projector_rank"): (
+        "spectral divide-and-conquer eigh reads the projector trace to "
+        "size the two subspace bases — the split rank IS data-dependent "
+        "output shape (ISSUE 19; same category as hSVD's adaptive rank)"
     ),
 }
 

@@ -426,8 +426,19 @@ def verify_plan(
         )
         # the planner's own resolution semantics: a forced SxC that does
         # not factor THIS spec's mesh falls back to flat, and plans that
-        # launch no collectives never carry the annotation at all
-        want = expected if (expected[0] * expected[1] == p and coll) else None
+        # launch no collectives never carry the annotation at all.
+        # Factorization ring schedules (ISSUE 19) are planned topology-
+        # blind — every collective is a nearest-neighbour ppermute hop of
+        # a pre-declared ring, so a forced topology never annotates them.
+        want = (
+            expected
+            if (
+                expected[0] * expected[1] == p
+                and coll
+                and not strategy.startswith("factorization-")
+            )
+            else None
+        )
         if got != want:
             fail(
                 "tier-labels",
@@ -588,6 +599,39 @@ def verify_plan(
                     "host-staging streams a host-resident operand — splits "
                     f"must be None (src={src}, dst={dst})"
                 )
+        elif strategy.startswith("factorization-"):
+            # ISSUE 19: the dense-factorization ring schedules
+            # (core/linalg/factorizations._factorization_plan) — every
+            # collective is a ppermute hop of a pre-declared ring, and
+            # the hop census per solver is a pinned contract
+            # (tests/test_factorizations.py proves census == plan)
+            if is_reshape:
+                return "a factorization plan never reshapes its operand"
+            if src != 0 or dst != 0:
+                return (
+                    f"factorization plans serve split-0 operands in place "
+                    f"(src={src}, dst={dst})"
+                )
+            kind_f = strategy[len("factorization-"):]
+            want = {
+                "polar": 5 * (p - 1),
+                "cholesky": p * (p - 1),
+                "lu": p * (p - 1) + (p - 1) ** 2,
+                "solve-chol": 2 * (p - 1) ** 2,
+                "solve-lu": 2 * (p - 1) ** 2,
+            }.get(kind_f)
+            if want is None:
+                return f"unknown factorization kind {kind_f!r}"
+            if set(coll_kinds) - {"ppermute"}:
+                return (
+                    f"factorization rings are ppermute-only — got "
+                    f"{sorted(set(coll_kinds))}"
+                )
+            if len(coll_kinds) != want:
+                return (
+                    f"factorization-{kind_f} at p={p} is exactly {want} "
+                    f"ppermute hop(s) — got {len(coll_kinds)}"
+                )
         else:
             return f"unknown strategy {strategy!r}"
         return None
@@ -655,6 +699,31 @@ def verify_plan(
                 else:
                     total += (L * (p - 1) // p // n_stage) * n_stage
             return total
+        if strategy.startswith("factorization-"):
+            # recompute the ring payloads from the spec geometry exactly
+            # as _factorization_plan prices them (norm-ring scalars ride
+            # the real component's width on complex dtypes)
+            kind_f = strategy[len("factorization-"):]
+            rt = (
+                item // 2
+                if str(spec.get("dtype", "")).startswith("complex")
+                else item
+            )
+            if kind_f == "polar":
+                n_cols = gshape[1]
+                mc = -(-n_cols // p)
+                return (p - 1) * rt + 4 * (p - 1) * mc * n_cols * item
+            nb = -(-gshape[0] // p)
+            if kind_f == "cholesky":
+                return p * (p - 1) * nb * nb * item
+            if kind_f == "lu":
+                n_pad = nb * p
+                return p * (p - 1) * nb * nb * item + sum(
+                    (p - 1) * nb * (n_pad - (k + 1) * nb) * item
+                    for k in range(p - 1)
+                )
+            if kind_f in ("solve-chol", "solve-lu"):
+                return 2 * (p - 1) ** 2 * nb * gshape[1] * item
         return None
 
     try:

@@ -138,12 +138,43 @@ def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int
     return _wrap(result, split, a)
 
 
+# past this order a distributed 2-D operand's inv/det runs the blocked
+# ring-LU suite (factorizations.py) instead of handing the sharded
+# logical array to XLA's one-device LU kernel — which GSPMD serves by
+# gathering and replicating the whole operand (the SL102/SL106 shape
+# the shardlint golden fixture pins)
+_BLOCKED_MIN_N = 512
+
+
+def _blocked_linalg_eligible(a: DNDarray) -> bool:
+    return (
+        a.ndim == 2
+        and not a._is_planar
+        and a.split in (0, 1)
+        and a.comm.is_distributed()
+        and int(a.shape[0]) >= _BLOCKED_MIN_N
+    )
+
+
 def det(a: DNDarray) -> DNDarray:
     """Determinant of (batched) square matrices (reference: basics.py:158
-    implements distributed LU with row bcasts; XLA's LU runs on-device)."""
+    implements distributed LU with row bcasts).
+
+    Distributed 2-D operands of order >= ``_BLOCKED_MIN_N`` run the
+    blocked ring-lookahead LU (``factorizations._lu_factor``) and read
+    the determinant off ``sign · prod(diag(U))`` — no gather-and-
+    replicate of the operand (ISSUE 19). Smaller or batched operands
+    keep XLA's on-device LU."""
     sanitize_in(a)
     if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
         raise ValueError(f"expected square matrix, got shape {a.shape}")
+    if _blocked_linalg_eligible(a):
+        from .factorizations import _lu_factor
+
+        _pvec, _l, u, sign = _lu_factor(a)
+        jt = u.dtype.jax_type()
+        result = sign.astype(jt) * jnp.prod(jnp.diagonal(u.larray))
+        return _wrap(result, None, a)
     arr = a.larray
     if types.heat_type_is_exact(a.dtype):
         arr = arr.astype(jnp.float32)
@@ -181,10 +212,29 @@ def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDar
 
 def inv(a: DNDarray) -> DNDarray:
     """Inverse of (batched) square matrices (reference: basics.py:310
-    distributed Gauss-Jordan; here XLA LU-based inverse)."""
+    distributed Gauss-Jordan).
+
+    Distributed 2-D operands of order >= ``_BLOCKED_MIN_N`` factor once
+    through the blocked ring-lookahead LU and back-substitute the
+    identity block-column-wise (``factorizations._solve_factored``) —
+    the operand and its inverse stay split the whole way, replacing the
+    gather-and-replicate ``jnp.linalg.inv`` path (ISSUE 19; see
+    MIGRATING.md). Smaller or batched operands keep XLA's on-device
+    kernel."""
     sanitize_in(a)
     if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
         raise ValueError(f"expected square matrix, got shape {a.shape}")
+    if _blocked_linalg_eligible(a):
+        from .. import factories
+        from .factorizations import _lu_factor, _solve_factored
+
+        pvec, l_arr, u_arr, _sign = _lu_factor(a)
+        rhs = factories.eye(
+            (int(a.shape[0]),) * 2, dtype=l_arr.dtype, split=0,
+            device=a.device, comm=a.comm,
+        )
+        x = _solve_factored("lu", rhs, l_arr, u_arr, pvec)
+        return x if x.split == a.split else x.resplit(a.split)
     arr = a.larray
     if types.heat_type_is_exact(a.dtype):
         arr = arr.astype(jnp.float32)

@@ -9,6 +9,13 @@ per iteration. Here each solver is ONE jitted program: ``cg`` runs a
 pre-allocated Krylov basis. The per-iteration dot-product all-reduces are
 emitted by XLA from the sharded matvecs — the same collectives the
 reference issues explicitly.
+
+DIRECT solves live elsewhere (ISSUE 19): ``ht.linalg.solve`` is the
+blocked-triangular back-substitution over the ring Cholesky/LU factors
+in :mod:`.factorizations` (re-exported at the ``ht.linalg`` root), with
+``assume_a="pos"`` for s.p.d. systems — prefer it over ``cg`` when the
+system is dense and factorable; ``cg`` remains the matrix-free /
+iterative option.
 """
 
 from __future__ import annotations

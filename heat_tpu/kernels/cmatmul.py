@@ -53,6 +53,7 @@ __all__ = [
     "grouped_ring_perm",
     "ring_enabled",
     "ring_all_gather",
+    "ring_bcast",
     "ring_matmul_reduce",
     "stamp_scope",
 ]
@@ -173,6 +174,43 @@ def ring_all_gather(
             out = place(out, cur, d)
             out, cur = lax.optimization_barrier((out, cur))
     return out
+
+
+def ring_bcast(
+    x: jax.Array,
+    axis_name: str,
+    size: int,
+    root,
+    perm: List[Tuple[int, int]],
+    pipelined: bool = True,
+):
+    """Broadcast device ``root``'s block around the +1 ring: ``size - 1``
+    neighbor hops, each device adopting the landed block exactly when its
+    ring distance from ``root`` equals the hop count — the row-panel
+    broadcast of the blocked LU trailing update (ScaLAPACK's ``Ibcast``
+    ring expressed as ppermutes, so the factorization census stays
+    ppermute-only and the shardlint stamp machinery applies unchanged).
+
+    ``root`` may be traced (the panel step index). Every device launches
+    every hop (SPMD congruence — the SL502 contract); non-root sources
+    forward zeros until the payload reaches them, after which they
+    forward the payload. The adopted value is selected by ring distance,
+    never accumulated, so the result is exact for any float payload and
+    bit-identical between the sequential and pipelined issue orders
+    (``pipelined=False`` pins each hop's adoption before the next hop
+    issues — the redistribution executor's sequential-oracle form).
+    """
+    if size <= 1:
+        return x
+    i = lax.axis_index(axis_name)
+    rel = (i - jnp.asarray(root, jnp.int32)) % size
+    v = jnp.where(rel == 0, x, jnp.zeros_like(x))
+    for d in range(1, size):
+        recv = lax.ppermute(v, axis_name, perm)
+        v = jnp.where(rel == d, recv, v)
+        if not pipelined:
+            (v,) = lax.optimization_barrier((v,))
+    return v
 
 
 def ring_matmul_reduce(

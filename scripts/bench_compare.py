@@ -96,6 +96,13 @@ HIGHER_IS_BETTER = {
     # the lattice's host->disk durable-commit bound (floor 0.5 pinned)
     "write_gbps",
     "bound_frac",
+    # dense-factorization acceptance field (ISSUE 19): the solver's
+    # flop rate over the SAME-RUN reference GEMM's rate (polar_2gb's
+    # floor is 0.5 — the bare GEMM is the ceiling by construction; the
+    # polar_2gb/eig_2gb `mfu` fields gate via `mfu` above, and the
+    # analytic 200 GB v5e-64 `model_*` fields hard-gate via ci.sh's
+    # --unchanged-fields sweep like every other analytic model output)
+    "frac_of_matmul",
     # sparse-engine acceptance fields (ISSUE 18): spmm_1gb's achieved
     # fraction of the lattice's nnz-weighted wire-mass floor (>= 0.5
     # pinned on CPU) and its same-run dense-matmul-twin ratio; the
@@ -147,6 +154,11 @@ LOWER_IS_BETTER = {
     # figure (the ci.sh calibration leg's shrinkage gate)
     "mean_abs_model_error",
     "mean_abs_calibrated_error",
+    # ISSUE 19: cholesky_2gb's measured seconds over its matmul-count
+    # time model (n³/3 flops at the same-run reference GEMM rate) —
+    # the acceptance bound is <= 2.0; growth means the ring-lookahead
+    # pipeline regressed against the matmuls it is made of
+    "vs_matmul_count",
     # ISSUE 18: pagerank_2m's iterations-to-tol — deterministic for the
     # seeded graph, so growth means an engine numerics change slowed
     # the fixpoint, not weather

@@ -71,10 +71,14 @@ HEAT_TPU_RELAYOUT_KERNEL=0 python -m pytest tests/test_kernels_relayout.py -q "$
 # linalg suites (Pallas-interpret compatible — the packed-pivot programs
 # run their relayout kernels in interpret mode on CPU) (leg 12); and the
 # HEAT_TPU_REDIST_OVERLAP=0 escape hatch, proving the sequential oracle
-# is bit-identical over the same surface (leg 13)
-HEAT_TPU_REDIST_OVERLAP=1 python -m pytest tests/test_overlap.py tests/test_redistribution.py tests/test_linalg.py tests/test_kernels_relayout.py -q "$@"
+# is bit-identical over the same surface (leg 13). ISSUE 19 extends
+# both legs over the dense-factorization suite: the ring schedules
+# (polar / eigh / cholesky / lu / solve) must be bit-identical under
+# pipelined and sequential issue order — the suite's pinned seq/pipe
+# parity tests run under BOTH gate values.
+HEAT_TPU_REDIST_OVERLAP=1 python -m pytest tests/test_overlap.py tests/test_redistribution.py tests/test_linalg.py tests/test_kernels_relayout.py tests/test_factorizations.py -q "$@"
 
-HEAT_TPU_REDIST_OVERLAP=0 python -m pytest tests/test_overlap.py tests/test_redistribution.py -q "$@"
+HEAT_TPU_REDIST_OVERLAP=0 python -m pytest tests/test_overlap.py tests/test_redistribution.py tests/test_factorizations.py -q "$@"
 
 # wire-quant legs (ISSUE 7), mirroring the overlap legs: the int8 wire
 # codec FORCED on CPU over the redistribution + optim suites — the

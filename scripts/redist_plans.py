@@ -74,6 +74,18 @@ def main() -> int:
 
     for name, sched in staging.golden_staged_plans():
         print(f"{name}{suffix}\t{sched.canonical_json()}")
+
+    # ISSUE 19: the dense-factorization ring schedules ride the same
+    # determinism + verify_plan sweep. Shapes/budget are pinned inside
+    # golden_factorization_plans (NOT the ambient env), and the plans
+    # are pure ppermute rings over a flat split-0 mesh — topology-free
+    # like the staged plans — so the tiered dump rows are identical to
+    # the flat ones by construction; dumped in every topology run so
+    # each diff pair covers them.
+    from heat_tpu.core.linalg.factorizations import golden_factorization_plans
+
+    for name, sched in golden_factorization_plans():
+        print(f"{name}{suffix}\t{sched.canonical_json()}")
     return 0
 
 

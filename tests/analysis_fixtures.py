@@ -943,3 +943,32 @@ def make_pagerank_step(comm, m, nb, B, alpha=0.85):
         return y * jnp.float32(alpha) + teleport
 
     return step
+
+
+# --------------------------------------------------------------------- #
+# ISSUE 19: dense-factorization fixtures                                #
+# --------------------------------------------------------------------- #
+def gather_inv_program(x, check_cond=False):
+    """ISSUE 19 golden bad-fixture: the pre-factorization inverse path
+    writ explicit — gather the whole sharded matrix replicated and hand
+    the copy to XLA's one-device LU inverse.
+
+    - SL102: the replicated constraint materializes every byte of the
+      operand on every device (an all-gather of the full matrix — the
+      blocked ring-LU of ``ht.linalg.inv``/``solve`` moves only
+      block-panel ppermutes, its clean twin pinned alongside);
+    - SL106: the debug arm reads the conditioning estimate back on the
+      host — never taken at trace time, only the source scan sees it.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    phys = x._phys
+    # SL102: whole-operand replicated materialization
+    rep = lax.with_sharding_constraint(phys, x.comm.sharding(phys.ndim, None))
+    out = jnp.linalg.inv(rep)
+    if check_cond:
+        host = jax.device_get(out)  # shardlint: ignore[SL201] -- fixture
+        print(float(abs(host).max()))  # SL106: host concretization
+    return out

@@ -254,6 +254,49 @@ class TestIRCheckCleanContracts(TestCase):
         self.assertEqual(rep.errors, [])
 
 
+class TestFactorizationLint(TestCase):
+    """ISSUE 19: the gather-then-``jnp.linalg.inv`` anti-pattern (the
+    path ``ht.linalg.inv`` ran before the blocked ring-LU) trips
+    SL102/SL106 as a golden bad fixture, and the blocked ``solve`` that
+    replaced it is pinned memcheck-clean and SL-clean."""
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_gather_inv_fixture_trips_sl102_sl106(self):
+        x = ht.random.randn(2560, 2560, split=0)
+        rep = ht.analysis.check(fx.gather_inv_program, x)
+        ids = set(rep.rule_ids)
+        self.assertIn("SL102", ids)  # whole-operand replicated gather
+        self.assertIn("SL106", ids)  # host read in the debug arm
+        gather = rep.by_rule("SL102")[0]
+        self.assertEqual(gather.severity, "error")
+        self.assertGreaterEqual(gather.nbytes, 2560 * 2560 * 4)
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_blocked_solve_sl_clean(self):
+        n = 128 * P
+        a = ht.random.randn(n, n, split=0) * 0.01 + ht.eye((n, n), split=0) * 4
+        b = ht.random.randn(n, 16, split=0)
+        rep = ht.analysis.check(
+            lambda u, v: ht.linalg.solve(u, v, assume_a="pos"), a, b
+        )
+        self.assertEqual(rep.errors, [])
+        # the plan-stamped panel rings report at info only
+        hops = [f for f in rep.findings if f.op == "collective-permute"]
+        for f in hops:
+            self.assertEqual(f.severity, "info")
+
+    @pytest.mark.skipif(P < 2, reason="needs a real mesh")
+    def test_blocked_solve_memcheck_clean(self):
+        n = 128 * P
+        a = ht.random.randn(n, n, split=0) * 0.01 + ht.eye((n, n), split=0) * 4
+        b = ht.random.randn(n, 16, split=0)
+        rep = ht.analysis.memcheck(
+            lambda u, v: ht.linalg.solve(u, v, assume_a="pos"), a, b
+        )
+        self.assertEqual(rep.errors, [])
+        self.assertGreater(rep.context["static_peak_bytes"], 0)
+
+
 class TestMemCheckGoldenFixtures(TestCase):
     """ISSUE 10 (pass 3, memcheck): each SL3xx golden bad fixture trips
     at its pinned severity, and the shipped contracts — TSQR, hSVD

@@ -258,24 +258,37 @@ class TestCacheKeyByteIdentity(TestCase):
         self.assertEqual(got, _PR11_PLAN_IDS)
 
     def test_golden_dump_bytes_unchanged_from_pr11(self):
-        """The full `scripts/redist_plans.py` dump — every canonical
-        plan serialization, quant twins included — byte-identical to
-        PR 11 HEAD (sha256 captured there), flat and at the forced 2x8
-        two-tier topology."""
+        """The `scripts/redist_plans.py` dump — every canonical plan
+        serialization, quant twins included — byte-identical to PR 11
+        HEAD (sha256 captured there), flat and at the forced 2x8
+        two-tier topology. ISSUE 19 APPENDED the five factorization
+        rows (``golden_factorization_plans``): the PR 11 pin now holds
+        over the dump minus that suffix — the pre-existing rows must
+        never drift — and a second pin holds the full dump including
+        the appended rows."""
         import hashlib
         import subprocess
         import sys
 
         pinned = {
-            (): "7f180a82cfcb327cc839728fb972cac0d6cfc37374119da1082d46c40318854e",
-            ("--topology", "2x8"): "415455b3a8d83a21b050763f26ababb4d1b3ff3876b5fe992434544565d330a4",
+            (): (
+                "7f180a82cfcb327cc839728fb972cac0d6cfc37374119da1082d46c40318854e",
+                "5148ccf9de9537c1e56050b913655deb51e7ea9e5d77415acb5840bace3cdb9d",
+            ),
+            ("--topology", "2x8"): (
+                "415455b3a8d83a21b050763f26ababb4d1b3ff3876b5fe992434544565d330a4",
+                "fb6fe31cd1b67a9c76ea4e815c9752d8fa75b5035cf40ea88475e5976e433674",
+            ),
         }
-        for extra, want in pinned.items():
+        n_fac = 5  # the ISSUE 19 factorization rows, appended last
+        for extra, (want_pr11, want_full) in pinned.items():
             out = subprocess.run(
                 [sys.executable, os.path.join(ROOT, "scripts", "redist_plans.py"), *extra],
                 capture_output=True, check=True, cwd=ROOT,
             ).stdout
-            self.assertEqual(hashlib.sha256(out).hexdigest(), want, extra)
+            prefix = b"".join(out.splitlines(keepends=True)[:-n_fac])
+            self.assertEqual(hashlib.sha256(prefix).hexdigest(), want_pr11, extra)
+            self.assertEqual(hashlib.sha256(out).hexdigest(), want_full, extra)
 
     def test_aot_fingerprint_empty_at_defaults(self):
         with env_pin("HEAT_TPU_OOC", None), env_pin("HEAT_TPU_WIRE_QUANT", None):

@@ -485,6 +485,62 @@ class TestComplexNativeLinalg(TestCase):
             T1.numpy()[0, 0], v1.conj() @ H @ v1, rtol=1e-3
         )
 
+    def test_polar_complex(self):
+        """ISSUE 19: the Newton–Schulz iteration's inner products are
+        X^H X / U^H A — a missed conjugation (the PR 5 bug class) makes
+        U non-unitary and H non-Hermitian on complex input. Pin the
+        defining identities: A = U H, U^H U = I, H = H^H PSD."""
+        A = self._cplx(48, 12, seed=11)
+        for split in (None, 0):
+            u, h = ht.linalg.polar(ht.array(A, split=split))
+            un, hn = u.numpy(), h.numpy()
+            np.testing.assert_allclose(un @ hn, A, atol=1e-4)
+            np.testing.assert_allclose(
+                un.conj().T @ un, np.eye(12), atol=1e-4
+            )
+            # H exactly Hermitian by construction (symmetrized return)
+            np.testing.assert_allclose(hn, hn.conj().T, atol=0)
+            self.assertTrue(np.all(np.linalg.eigvalsh(hn) >= -1e-3))
+
+    def test_eigh_complex(self):
+        """A = V diag(w) V^H with unitary V and REAL eigenvalues — the
+        conjugate-transpose contract of the spectral divide-and-conquer
+        compression Q^H (A Q)."""
+        C = self._cplx(24, 24, seed=12)
+        H = (C @ C.conj().T + 24 * np.eye(24)).astype(np.complex64)
+        for split in (None, 0):
+            w, v = ht.linalg.eigh(ht.array(H, split=split))
+            wn, vn = w.numpy(), v.numpy()
+            self.assertFalse(np.iscomplexobj(wn) and np.abs(wn.imag).max() > 0)
+            np.testing.assert_allclose(
+                vn @ np.diag(wn) @ vn.conj().T, H, atol=1e-2
+            )
+            np.testing.assert_allclose(
+                vn.conj().T @ vn, np.eye(24), atol=1e-4
+            )
+            np.testing.assert_allclose(
+                np.sort(np.real(wn)), np.linalg.eigvalsh(H), rtol=1e-4
+            )
+
+    def test_cholesky_complex(self):
+        """A = L L^H with lower-triangular L — the trailing update
+        subtracts L_panel (L_col)^H; a dropped conj breaks hermitian
+        positive-definiteness of the remainder."""
+        C = self._cplx(24, 24, seed=13)
+        H = (C @ C.conj().T + 24 * np.eye(24)).astype(np.complex64)
+        for split in (None, 0):
+            l = ht.linalg.cholesky(ht.array(H, split=split))
+            ln = l.numpy()
+            np.testing.assert_allclose(ln @ ln.conj().T, H, atol=1e-2)
+            np.testing.assert_allclose(ln, np.tril(ln), atol=1e-6)
+            # solve rides the same conjugated triangular chain
+            b = self._cplx(24, 3, seed=14)
+            x = ht.linalg.solve(
+                ht.array(H, split=split), ht.array(b, split=split),
+                assume_a="pos",
+            )
+            np.testing.assert_allclose(H @ x.numpy(), b, atol=1e-2)
+
 
 if __name__ == "__main__":
     import unittest
